@@ -1,0 +1,25 @@
+"""ponyc_tpu — a TPU-native actor framework.
+
+A from-scratch re-design of the Pony actor runtime's capabilities
+(reference: KittyMac/ponyc, src/libponyrt — work-stealing scheduler,
+per-actor MPSC mailboxes, ORCA GC, backpressure, async I/O) for TPU
+hardware: actor state and mailboxes are struct-of-arrays in HBM, behaviour
+dispatch is a vmapped `lax.switch` kernel draining batched messages in
+lockstep across actor cohorts, message routing is one sort+scatter per
+tick (ICI collectives across chips), and I/O + bookkeeping stay host-side.
+
+See SURVEY.md at the repo root for the full mapping to the reference.
+"""
+
+from .api import (Actor, Bool, Context, F32, I32, Ref, actor, be, behaviour)
+from .config import RuntimeOptions, options_from_env, strip_runtime_flags
+from .program import Program
+from .runtime.runtime import Runtime, SpillOverflowError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Actor", "Bool", "Context", "F32", "I32", "Ref", "actor", "be",
+    "behaviour", "RuntimeOptions", "options_from_env",
+    "strip_runtime_flags", "Program", "Runtime", "SpillOverflowError",
+]

@@ -1,0 +1,189 @@
+"""Front-end API: actor types, behaviours, and the per-dispatch Context.
+
+This is the TPU framework's equivalent of the Pony *language surface* for
+actors: an ``actor`` class with ``be`` behaviours (reference: the compiler
+lowers each behaviour into a message-send stub + a dispatch case,
+src/libponyc/codegen/genfun.c; actor hints tag/priority/batch/main-thread
+are lazily read from per-type hint functions, src/libponyrt/actor/
+actor.c:398-423 — here they are plain class attributes, resolved at program
+build time because the whole actor world is compiled as one XLA program,
+the same way reach.c assumes whole-program knowledge).
+
+Behaviours are *pure traced functions*::
+
+    @actor
+    class RingNode:
+        next_ref: Ref            # per-actor state field (annotation = dtype)
+        passes:   I32
+
+        @behaviour
+        def token(self, st, hops: I32):
+            self.send(st["next_ref"], RingNode.token, hops - 1,
+                      when=hops > 0)
+            self.exit(0, when=hops <= 0)
+            return st
+
+``self`` inside a behaviour is a Context, not the object: it carries the
+actor's global id and collects the side effects (sends, exit, yield) that
+the engine turns into batched device operations. The state dict ``st`` is
+functional — return the updated dict.
+
+The number of ``self.send(...)`` calls per behaviour must be static (it is
+traced once); data-dependent sends use ``when=`` masks, exactly as XLA
+requires (`lax.cond` under vmap selects, it does not branch).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .ops import pack
+from .ops.pack import Bool, F32, I32, Ref  # re-exported
+
+
+class BehaviourDef:
+    """A behaviour declaration: dispatch id + typed argument spec.
+
+    ≙ a Pony behaviour's (message id, param list); global ids are assigned
+    at program build (≙ reach/paint vtable colouring, reach/paint.c:8-60).
+    """
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.name = fn.__name__
+        sig = inspect.signature(fn)
+        params = list(sig.parameters.values())[2:]  # drop (self, st)
+        self.arg_specs = tuple(
+            pack.normalize_annotation(
+                p.annotation if p.annotation is not inspect.Parameter.empty
+                else I32)
+            for p in params)
+        self.arg_names = tuple(p.name for p in params)
+        # Filled in by program build:
+        self.global_id: Optional[int] = None
+        self.local_id: Optional[int] = None
+        self.actor_type: Optional["ActorTypeMeta"] = None
+
+    def __repr__(self):
+        owner = self.actor_type.__name__ if self.actor_type else "?"
+        return f"<behaviour {owner}.{self.name} gid={self.global_id}>"
+
+
+def behaviour(fn):
+    """Mark a method as an actor behaviour (≙ Pony ``be``)."""
+    return BehaviourDef(fn)
+
+
+# Alias matching Pony's keyword.
+be = behaviour
+
+
+class ActorTypeMeta(type):
+    """Metaclass collecting state fields + behaviours from the class body."""
+
+    def __new__(mcs, name, bases, ns):
+        fields: Dict[str, Any] = {}
+        inherited: List[BehaviourDef] = []
+        for base in bases:
+            fields.update(getattr(base, "_fields", {}))
+            inherited.extend(getattr(base, "_behaviours", []))
+        for key, val in list(ns.get("__annotations__", {}).items()):
+            if key.startswith("_") or key.isupper():
+                continue
+            fields[key] = pack.normalize_annotation(val)
+        own = [val for val in ns.values() if isinstance(val, BehaviourDef)]
+        cls = super().__new__(mcs, name, bases, ns)
+        # Inherited behaviours get a *fresh* BehaviourDef per subclass:
+        # dispatch ids are per-(type, behaviour) slots (≙ paint.c vtable
+        # colouring), so sharing one def across types would let finalize()
+        # clobber ids. The copy is also set as a class attribute so
+        # `Sub.ping` resolves to Sub's slot, not the base's.
+        behaviours: List[BehaviourDef] = []
+        own_names = {b.name for b in own}
+        for b in inherited:
+            if b.name in own_names:   # overridden in this class body
+                continue
+            copy = BehaviourDef(b.fn)
+            setattr(cls, copy.name, copy)
+            behaviours.append(copy)
+        behaviours.extend(own)
+        cls._fields = fields
+        cls._behaviours = behaviours
+        for b in behaviours:
+            b.actor_type = cls
+        # Scheduling hints (≙ actor.c:398-423 lazy hint fns):
+        cls.BATCH = ns.get("BATCH", None)        # msgs per step override
+        cls.PRIORITY = ns.get("PRIORITY", 0)     # ≙ fork's priority hint
+        cls.HOST = ns.get("HOST", False)         # ≙ use_main_thread: runs on host
+        cls.TAG = ns.get("TAG", 0)               # ≙ fork's analysis tag
+        return cls
+
+    @property
+    def field_specs(cls):
+        return cls._fields
+
+    @property
+    def behaviour_defs(cls):
+        return cls._behaviours
+
+
+class Actor(metaclass=ActorTypeMeta):
+    """Base class for actor types (subclass + annotate fields)."""
+
+
+def actor(cls):
+    """Class decorator: turn a plain class into an actor type."""
+    ns = dict(cls.__dict__)
+    ns.pop("__dict__", None)
+    ns.pop("__weakref__", None)
+    return ActorTypeMeta(cls.__name__, (Actor,), ns)
+
+
+class Context:
+    """Per-dispatch effect collector, passed as ``self`` to behaviours.
+
+    ≙ pony_ctx_t + the send/exit runtime entry points (pony_sendv
+    actor.c:773, pony_exitcode start.c:345). All effects are masked arrays;
+    the engine pads them to the type's static send budget.
+    """
+
+    __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
+                 "yield_flag", "_spawns")
+
+    def __init__(self, actor_id, msg_words: int):
+        self.actor_id = actor_id          # traced i32 scalar (global id)
+        self.msg_words = msg_words
+        self.sends: List[Tuple[Any, Any, Any]] = []   # (target, words, when)
+        self.exit_flag = jnp.bool_(False)
+        self.exit_code = jnp.int32(0)
+        self.yield_flag = jnp.bool_(False)
+
+    # -- messaging (≙ pony_sendv, actor.c:773-834) --
+    def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
+        if not isinstance(behaviour_def, BehaviourDef):
+            raise TypeError("second argument to send() must be a behaviour "
+                            "(e.g. SomeActor.some_behaviour)")
+        if behaviour_def.global_id is None:
+            raise RuntimeError(
+                f"{behaviour_def} not registered in a Program yet")
+        payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
+        words = jnp.concatenate(
+            [jnp.asarray([behaviour_def.global_id], jnp.int32), payload])
+        self.sends.append((jnp.asarray(target, jnp.int32), words,
+                           jnp.asarray(when, jnp.bool_)))
+
+    # -- lifecycle --
+    def exit(self, code=0, when=True):
+        """Request program termination (≙ pony_exitcode + quiescent stop)."""
+        w = jnp.asarray(when, jnp.bool_)
+        self.exit_flag = self.exit_flag | w
+        self.exit_code = jnp.where(w, jnp.asarray(code, jnp.int32),
+                                   self.exit_code)
+
+    def yield_(self, when=True):
+        """Stop draining this actor's mailbox for the rest of the step
+        (≙ the fork's ponyint_actor_yield, actor.c:675-679)."""
+        self.yield_flag = self.yield_flag | jnp.asarray(when, jnp.bool_)

@@ -1,0 +1,153 @@
+"""Runtime options — TPU-native equivalent of the reference's runtime flag
+system (reference: src/libponyrt/sched/start.c:75-94 parses --ponymaxthreads/
+minthreads/noscale/suspendthreshold/cdinterval/gcinitial/gcfactor/noyield/
+noblock/analysis/mainthread/pin/pinasio; src/libponyrt/options/options.c is
+the shared getopt-ish parser).
+
+On a TPU there are no scheduler *threads* to scale; the analogous knobs are
+the static shapes of the device-resident actor world: mailbox capacity,
+per-step drain batch, maximum sends per behaviour invocation, spill-buffer
+capacity, and the cadence of host-side bookkeeping (quiescence checks ≙ the
+CNF/ACK protocol interval, cycle-detection interval ≙ --ponycdinterval).
+
+Flags are accepted both programmatically (RuntimeOptions(...)), from the
+environment (PONY_TPU_<NAME>), and from argv (--pony<name> value), mirroring
+how the reference strips --pony* flags from argv before the app sees them
+(start.c:185-261).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeOptions:
+    """Static configuration of a runtime instance (≙ opt_t in start.c).
+
+    Everything here is a *trace-time constant*: changing any field re-traces
+    the dispatch step (XLA programs have static shapes).
+    """
+
+    # --- mailbox / message geometry (≙ messageq.c + actor.c batch) ---
+    mailbox_cap: int = 64          # per-actor ring capacity (power of two)
+    msg_words: int = 6             # payload words per message (int32 lanes)
+    batch: int = 8                 # default msgs drained per actor per step
+    #   (reference default batch is 100 msgs per *scheduler run*
+    #    (actor.c:20); a TPU "step" is much finer-grained, so the default
+    #    is lower; per-type override via `BATCH` class attr ≙ the fork's
+    #    lazily-initialised batch hint fn, actor.c:417-422.)
+    max_sends: int = 2             # default max ctx.send() calls per behaviour
+
+    # --- backpressure (≙ actor.c:1103-1235, scheduler.c:1478-1635) ---
+    overload_threshold: float = 0.75   # occupancy fraction that marks an
+    #   actor OVERLOADED (reference: failing to drain within one batch,
+    #   actor.c:369-381; occupancy is the steady-state TPU analog)
+    unmute_threshold: float = 0.25     # occupancy fraction under which a
+    #   muting receiver releases its senders (hysteresis)
+    spill_cap: int = 4096          # device overflow-spill entries (≙ the
+    #   unbounded pool-backed queues of the reference; bounded here because
+    #   XLA shapes are static — overflow beyond this raises)
+
+    # --- lifecycle / quiescence (≙ scheduler.c:303-480 CNF/ACK) ---
+    quiesce_interval: int = 1      # host checks the device work-bit every
+    #   N steps (1 = every step; raise to amortise device→host latency)
+    cd_interval: int = 128         # steps between cycle-detector scans
+    #   (≙ --ponycdinterval default 100ms, start.c:206)
+    noblock: bool = False          # ≙ --ponynoblock: disable cycle detection
+    noyield: bool = False          # ≙ --ponynoyield: ignore yield hints
+    max_steps: Optional[int] = None  # safety valve for tests
+
+    # --- host bridge (≙ asio/) ---
+    inject_slots: int = 256        # host→device injected msgs per step
+    host_out_slots: int = 256      # device→host delivered msgs per step
+
+    # --- analysis / telemetry (≙ --ponyanalysis, analysis.c) ---
+    analysis: int = 0              # 0 off, 1 summary, 2 full event CSV
+    analysis_path: str = "/tmp/pony_tpu.analytics.csv"
+
+    # --- sharding (≙ the scale axis the reference lacks; SURVEY §2.4) ---
+    mesh_shards: int = 1           # actor-axis shards (1 = single chip)
+
+    def __post_init__(self):
+        if self.mailbox_cap & (self.mailbox_cap - 1):
+            raise ValueError("mailbox_cap must be a power of two")
+        if self.msg_words < 1:
+            raise ValueError("msg_words must be >= 1")
+        if self.batch < 1:
+            raise ValueError("batch must be >= 1")
+
+    @property
+    def overload_occ(self) -> int:
+        return max(1, int(self.mailbox_cap * self.overload_threshold))
+
+    @property
+    def unmute_occ(self) -> int:
+        return max(0, int(self.mailbox_cap * self.unmute_threshold))
+
+
+_FLAG_TYPES = {f.name: f.type for f in dataclasses.fields(RuntimeOptions)}
+
+
+def _coerce(name: str, raw: str):
+    ty = _FLAG_TYPES[name]
+    if ty in ("bool", bool):
+        return raw.lower() in ("1", "true", "yes", "on", "")
+    if ty in ("int", int, "Optional[int]", Optional[int]):
+        return int(raw)
+    if ty in ("float", float):
+        return float(raw)
+    return raw
+
+
+def options_from_env(base: Optional[RuntimeOptions] = None) -> RuntimeOptions:
+    """Read PONY_TPU_* environment overrides (≙ start.c env handling)."""
+    base = base or RuntimeOptions()
+    overrides = {}
+    for name in _FLAG_TYPES:
+        raw = os.environ.get("PONY_TPU_" + name.upper())
+        if raw is not None:
+            overrides[name] = _coerce(name, raw)
+    return dataclasses.replace(base, **overrides)
+
+
+def strip_runtime_flags(argv: Optional[List[str]] = None,
+                        base: Optional[RuntimeOptions] = None):
+    """Parse and remove --pony* flags from argv, returning (opts, rest).
+
+    ≙ pony_init's argv filtering (start.c:185-261): the application never
+    sees runtime flags. Accepted spellings: --pony_mailbox_cap 64,
+    --ponymailboxcap=64 (underscores optional).
+    """
+    argv = list(sys.argv if argv is None else argv)
+    canon = {name.replace("_", ""): name for name in _FLAG_TYPES}
+    rest, overrides, i = [], {}, 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--pony"):
+            body = a[6:].lstrip("_")
+            if "=" in body:
+                key, raw = body.split("=", 1)
+            else:
+                key, raw = body, None
+            key = key.replace("_", "")
+            if key in canon:
+                name = canon[key]
+                if raw is None:
+                    if _FLAG_TYPES[name] in ("bool", bool):
+                        raw = "true"
+                    else:
+                        i += 1
+                        if i >= len(argv):
+                            raise ValueError(f"missing value for flag {a}")
+                        raw = argv[i]
+                overrides[name] = _coerce(name, raw)
+                i += 1
+                continue
+        rest.append(a)
+        i += 1
+    base = options_from_env(base)
+    return dataclasses.replace(base, **overrides), rest

@@ -1,0 +1,3 @@
+"""Benchmark/actor-program families mirroring the reference's examples/
+(ring, message-ubench, fan-in, gups, n-body) — the workloads BASELINE.md
+tracks."""

@@ -1,0 +1,50 @@
+"""Token ring — port of the reference benchmark `examples/ring/main.pony`:
+N ring actors each hold a reference to the next; a token message carries a
+remaining-pass count and hops around the ring until it reaches zero.
+
+In the reference each hop is one mailbox push + one scheduler pop; here a
+full ring of R tokens advances every actor one hop per *step* (the ring is
+embarrassingly parallel at width R). With a single token the ring measures
+pure per-hop dispatch latency, the same thing the Pony example measures.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+
+@actor
+class RingNode:
+    next_ref: Ref
+    passes: I32     # hops observed by this node (for verification)
+
+    @behaviour
+    def token(self, st, hops: I32):
+        self.send(st["next_ref"], RingNode.token, hops - 1, when=hops > 1)
+        self.exit(0, when=hops <= 1)
+        return {**st, "passes": st["passes"] + 1}
+
+
+def build(n_nodes: int = 1024, opts: RuntimeOptions | None = None
+          ) -> tuple[Runtime, np.ndarray]:
+    rt = Runtime(opts or RuntimeOptions(mailbox_cap=8, batch=1,
+                                        max_sends=1, msg_words=1))
+    rt.declare(RingNode, n_nodes)
+    rt.start()
+    ids = rt.spawn_many(RingNode, n_nodes)
+    nxt = np.roll(ids, -1)
+    # Wire next_ref after spawn (ids are only known once allocated).
+    rt.set_fields(RingNode, ids, next_ref=nxt)
+    return rt, ids
+
+
+def run(n_nodes: int = 1024, hops: int = 4096, n_tokens: int = 1,
+        opts: RuntimeOptions | None = None) -> Runtime:
+    rt, ids = build(n_nodes, opts)
+    step = max(1, n_nodes // max(1, n_tokens))
+    for t in range(n_tokens):
+        rt.send(int(ids[(t * step) % n_nodes]), RingNode.token, hops)
+    rt.run()
+    return rt

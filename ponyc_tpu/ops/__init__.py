@@ -1,0 +1,2 @@
+"""Low-level ops: payload packing, segment primitives, (later) Pallas
+kernels for the dispatch/delivery hot path."""

@@ -1,0 +1,85 @@
+"""Message payload packing — TPU equivalent of the reference compiler's
+behaviour message pack/unpack (reference: src/libponyc/codegen/genfun.c emits
+a pony_msg_t subtype per behaviour and packs arguments into it; the dispatch
+switch unpacks them).
+
+Here every message on the wire is a fixed vector of int32 words:
+``[behaviour_id, arg0, arg1, ...]``. Typed arguments (f32, i32, bool,
+ActorRef) are bitcast into words according to the behaviour's signature
+annotations, and bitcast back at dispatch. Keeping the transport monomorphic
+is what lets mailboxes live as one dense [N, cap, words] HBM array.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class I32:
+    """Marker annotation: 32-bit signed integer argument."""
+
+
+class F32:
+    """Marker annotation: 32-bit float argument (bitcast into an i32 lane)."""
+
+
+class Bool:
+    """Marker annotation: boolean argument."""
+
+
+class Ref:
+    """Marker annotation: actor reference (global actor id, i32)."""
+
+
+_MARKERS = (I32, F32, Bool, Ref)
+
+
+def normalize_annotation(ann):
+    """Map a user annotation to one of the marker classes."""
+    if ann in _MARKERS:
+        return ann
+    if ann in (int, jnp.int32, "int", "I32", "i32"):
+        return I32
+    if ann in (float, jnp.float32, "float", "F32", "f32"):
+        return F32
+    if ann in (bool, jnp.bool_, "bool", "Bool"):
+        return Bool
+    if ann in ("Ref", "ActorRef"):
+        return Ref
+    raise TypeError(f"unsupported behaviour argument annotation: {ann!r}")
+
+
+def pack_arg(ann, value):
+    """Encode one argument into an int32 word (trace-time, scalar)."""
+    if ann is F32:
+        return jnp.asarray(value, jnp.float32).view(jnp.int32)
+    if ann is Bool:
+        return jnp.asarray(value, jnp.bool_).astype(jnp.int32)
+    return jnp.asarray(value, jnp.int32)
+
+
+def unpack_arg(ann, word):
+    """Decode one int32 word back to its annotated type."""
+    if ann is F32:
+        return word.view(jnp.float32)
+    if ann is Bool:
+        return word.astype(jnp.bool_)
+    return word
+
+
+def pack_args(specs, values, msg_words):
+    """Pack positional args into a [msg_words] int32 vector (zero padded)."""
+    if len(values) != len(specs):
+        raise TypeError(f"behaviour takes {len(specs)} args, got {len(values)}")
+    if len(specs) > msg_words:
+        raise TypeError(
+            f"behaviour needs {len(specs)} payload words but msg_words="
+            f"{msg_words}; raise RuntimeOptions.msg_words")
+    words = [pack_arg(a, v) for a, v in zip(specs, values)]
+    words += [jnp.int32(0)] * (msg_words - len(words))
+    return jnp.stack(words)
+
+
+def unpack_args(specs, words):
+    """Inverse of pack_args; returns a tuple of typed scalars."""
+    return tuple(unpack_arg(a, words[i]) for i, a in enumerate(specs))

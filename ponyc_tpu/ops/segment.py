@@ -1,0 +1,51 @@
+"""Sorted-segment primitives used by batched message delivery.
+
+The delivery problem (scatter-append K messages into N ring buffers while
+preserving per-sender order and respecting capacity) is solved the
+XLA-friendly way: stable sort by target, compute each entry's *rank within
+its target segment* with a prefix max, then one scatter. These helpers are
+shared by single-chip delivery and the per-shard delivery inside shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stable_sort_by(keys: jnp.ndarray):
+    """Return the permutation that stably sorts int32 keys ascending."""
+    return jnp.argsort(keys, stable=True)
+
+
+def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
+    """Given keys already sorted ascending, return each element's index
+    within its run of equal keys. [3,3,5,5,5,9] → [0,1,0,1,2,0]."""
+    n = sorted_keys.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_keys[1:] != sorted_keys[:-1]])
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, idx, 0))
+    return idx - seg_start
+
+
+def counts_by_key(keys: jnp.ndarray, weights: jnp.ndarray,
+                  num_buckets: int) -> jnp.ndarray:
+    """Scatter-add weights into num_buckets by key; out-of-range keys drop."""
+    out = jnp.zeros((num_buckets,), weights.dtype)
+    return out.at[keys].add(weights, mode="drop")
+
+
+def compact_mask(mask: jnp.ndarray, cap: int):
+    """Stable-compact True entries to the front, truncated/padded to cap.
+
+    Returns (perm[cap], valid[cap], total_true). perm indexes the original
+    array; entries beyond total_true are padding (valid=False). Order of the
+    selected entries is preserved (stable sort on ~mask).
+    """
+    total = jnp.sum(mask.astype(jnp.int32))
+    perm = jnp.argsort(~mask, stable=True)
+    perm = perm[:cap]
+    valid = jnp.arange(cap, dtype=jnp.int32) < total
+    return perm, valid, total

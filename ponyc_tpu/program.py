@@ -1,0 +1,127 @@
+"""Program build: whole-world actor-type registry → cohorts + dispatch table.
+
+≙ the reference compiler's reachability + vtable painting stage
+(src/libponyc/reach/reach.c builds the whole-program reachable type/method
+set from Main; reach/paint.c colours method names into dispatch-table slots).
+On TPU the same whole-program knowledge is what makes behaviour dispatch
+vectorisable: actors are grouped into *cohorts by type* so each cohort's
+dispatch is a `lax.switch` over only that type's behaviours (SURVEY.md §7
+hard part (b) — heterogeneity kills vectorisation, cohorts bound it).
+
+Global actor ids are a single [0, N) range; each type owns a contiguous
+slice, so a message's routing needs only the id (the mailbox table is one
+dense array) while dispatch semantics come from the owning cohort.
+Behaviour ids are *global* (word 0 of every message); each cohort's switch
+re-bases them and treats out-of-range ids as a traced no-op — the dynamic
+analog of the type check Pony does statically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .api import ActorTypeMeta
+from .config import RuntimeOptions
+
+
+class Cohort:
+    """A contiguous id-range of actors of one type (≙ one reach_type_t)."""
+
+    def __init__(self, atype: ActorTypeMeta, start: int, capacity: int,
+                 opts: RuntimeOptions):
+        self.atype = atype
+        self.start = start
+        self.capacity = capacity            # max live actors of this type
+        self.batch = atype.BATCH or opts.batch
+        self.priority = atype.PRIORITY
+        self.host = bool(atype.HOST)
+        # Static send budget: max ctx.send() calls across this type's
+        # behaviours is discovered at trace time; the declared bound here is
+        # the engine's outbox width. Behaviours exceeding it fail loudly at
+        # trace, not silently at run.
+        self.max_sends = getattr(atype, "MAX_SENDS", None) or opts.max_sends
+        self.behaviours = list(atype.behaviour_defs)
+
+    @property
+    def stop(self) -> int:
+        return self.start + self.capacity
+
+    def __repr__(self):
+        return (f"<cohort {self.atype.__name__} ids=[{self.start},"
+                f"{self.stop}) batch={self.batch}>")
+
+
+class Program:
+    """The compiled actor world: types, capacities, id layout, dispatch ids.
+
+    Build order (≙ pass pipeline tail, pass.h:208-231 reach→paint→codegen):
+      1. declare(Type, capacity) for every actor type
+      2. finalize() assigns cohort id ranges + global behaviour ids
+      3. the engine traces one dispatch step over the frozen layout
+    """
+
+    def __init__(self, opts: Optional[RuntimeOptions] = None):
+        self.opts = opts or RuntimeOptions()
+        self._declared: List[Tuple[ActorTypeMeta, int]] = []
+        self.cohorts: List[Cohort] = []
+        self.by_type: Dict[ActorTypeMeta, Cohort] = {}
+        self.behaviour_table: List = []   # global id → BehaviourDef
+        self.total = 0
+        self.frozen = False
+
+    def declare(self, atype: ActorTypeMeta, capacity: int):
+        if self.frozen:
+            raise RuntimeError("Program already finalized")
+        if not isinstance(atype, ActorTypeMeta):
+            raise TypeError(f"{atype!r} is not an actor type (use @actor)")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._declared.append((atype, capacity))
+        return self
+
+    def finalize(self) -> "Program":
+        if self.frozen:
+            return self
+        # Host cohorts last: their ids sit in a contiguous tail range so the
+        # device delivery can classify "host-bound" with one compare
+        # (≙ inject_main diverting use_main_thread actors, scheduler.c:179).
+        self._declared.sort(key=lambda tc: bool(tc[0].HOST))
+        offset = 0
+        for atype, cap in self._declared:
+            cohort = Cohort(atype, offset, cap, self.opts)
+            self.cohorts.append(cohort)
+            self.by_type[atype] = cohort
+            offset += cap
+        self.total = offset
+        gid = 0
+        for cohort in self.cohorts:
+            for local, bdef in enumerate(cohort.behaviours):
+                bdef.global_id = gid
+                bdef.local_id = local
+                self.behaviour_table.append(bdef)
+                gid += 1
+        self.frozen = True
+        return self
+
+    @property
+    def device_cohorts(self) -> List[Cohort]:
+        return [c for c in self.cohorts if not c.host]
+
+    @property
+    def host_cohorts(self) -> List[Cohort]:
+        return [c for c in self.cohorts if c.host]
+
+    @property
+    def first_host_id(self) -> int:
+        """Ids >= this are host-resident actors (tail range), or total if
+        there are none."""
+        for c in self.cohorts:
+            if c.host:
+                return c.start
+        return self.total
+
+    def cohort_of(self, actor_id: int) -> Cohort:
+        for c in self.cohorts:
+            if c.start <= actor_id < c.stop:
+                return c
+        raise IndexError(f"actor id {actor_id} out of range [0,{self.total})")

@@ -1,0 +1,3 @@
+"""Runtime core: device state, dispatch engine, delivery, host driver."""
+
+from .runtime import Runtime, SpillOverflowError  # noqa: F401

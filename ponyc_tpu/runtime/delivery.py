@@ -1,0 +1,116 @@
+"""Batched message delivery: the vectorised send path.
+
+≙ the reference's pony_sendv → ponyint_maybe_mute → messageq_push →
+ponyint_sched_add chain (src/libponyrt/actor/actor.c:773-968,
+actor/messageq.c:102-160), executed for *every in-flight message at once*:
+
+  1. gather all candidate messages for this tick — spill (oldest first),
+     host injections, then this step's freshly produced outbox;
+  2. stable-sort by target id: per-target arrival order is then
+     [older spill → inject → outbox-in-sender-slot-order], which preserves
+     the per-sender→receiver FIFO guarantee Pony gives (messageq FIFO +
+     causal send order; SURVEY.md §7 hard part (c)) because a sender whose
+     message was rejected is muted until its spill drains, so it can never
+     emit a *newer* message that would overtake an older spilled one;
+  3. rank each message within its target segment; accept while
+     rank < free-space (rejections are therefore always the newest suffix
+     per target, keeping FIFO safe);
+  4. one scatter writes all accepted payloads into the mailbox table;
+  5. rejections are stably compacted into the next spill buffer and their
+     senders muted (≙ ponyint_maybe_mute: mute on sending to an overloaded/
+     muted receiver, actor.c:898-921 — here "receiver rejected or is over
+     the occupancy threshold", the static-shape analog of the reference's
+     batch-exhaustion OVERLOADED flag, actor.c:369-381).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.segment import (compact_mask, counts_by_key, segment_ranks,
+                           stable_sort_by)
+
+
+class Entries(NamedTuple):
+    """A flat batch of in-flight messages."""
+    tgt: jnp.ndarray      # [E] int32 target actor id; -1 = empty slot
+    sender: jnp.ndarray   # [E] int32 sender id; >=N means "no sender" (host)
+    words: jnp.ndarray    # [E, 1+W] int32 (word0 = behaviour gid)
+
+
+class DeliveryResult(NamedTuple):
+    buf: jnp.ndarray
+    tail: jnp.ndarray
+    spill: Entries        # rejected entries, compacted, oldest first
+    spill_count: jnp.ndarray
+    spill_overflow: jnp.ndarray
+    newly_muted: jnp.ndarray   # [N] bool
+    new_mute_ref: jnp.ndarray  # [N] int32 (-1 where not newly muted)
+    n_delivered: jnp.ndarray
+    n_rejected: jnp.ndarray
+
+
+def deliver(buf, head, tail, entries: Entries, *, num_actors: int,
+            mailbox_cap: int, spill_cap: int, overload_occ: int
+            ) -> DeliveryResult:
+    n, c = num_actors, mailbox_cap
+    tgt, sender, words = entries
+
+    valid = (tgt >= 0) & (tgt < n)
+    key = jnp.where(valid, tgt, n).astype(jnp.int32)
+    perm = stable_sort_by(key)
+    kt = key[perm]
+    snd = sender[perm]
+    wds = words[perm]
+    ok = kt < n
+
+    rank = segment_ranks(kt)
+    ktc = jnp.minimum(kt, n - 1)
+    occ = tail - head
+    space = c - occ[ktc]
+    accept = ok & (rank < space)
+
+    slot = (tail[ktc] + rank) % c
+    scatter_row = jnp.where(accept, kt, n)          # row n → dropped
+    buf = buf.at[scatter_row, slot].set(wds, mode="drop")
+    acc_counts = counts_by_key(ktc, accept.astype(jnp.int32) *
+                               ok.astype(jnp.int32), n)
+    new_tail = tail + acc_counts
+    occ_after = new_tail - head
+
+    # Rejections → next spill, stable order (per-target order preserved).
+    rej = ok & ~accept
+    perm2, vspill, nrej = compact_mask(rej, spill_cap)
+    spill = Entries(
+        tgt=jnp.where(vspill, kt[perm2], -1),
+        sender=jnp.where(vspill, snd[perm2], n),
+        words=jnp.where(vspill[:, None], wds[perm2], 0),
+    )
+    spill_overflow = nrej > spill_cap
+
+    # Mute triggers (≙ actor.c:898-921 + mute rules actor.c:1171-1235):
+    # a *valid, actor-originated* send whose receiver rejected it or is now
+    # over the overload threshold mutes the sender — unless the sender is
+    # itself overloaded (the reference's !OVERLOADED/UNDER_PRESSURE guard,
+    # which prevents mute deadlocks among hot actors).
+    recv_hot = occ_after[ktc] > overload_occ
+    has_sender = (snd >= 0) & (snd < n)
+    sc = jnp.minimum(jnp.maximum(snd, 0), n - 1)
+    sender_hot = (new_tail[sc] - head[sc]) > overload_occ
+    trig = ok & has_sender & (rej | recv_hot) & ~sender_hot
+    mute_row = jnp.where(trig, sc, n)
+    newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
+        trig, mode="drop")
+    new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
+        jnp.where(trig, kt, -1), mode="drop")
+
+    return DeliveryResult(
+        buf=buf, tail=new_tail,
+        spill=spill, spill_count=jnp.minimum(nrej, spill_cap),
+        spill_overflow=spill_overflow,
+        newly_muted=newly_muted, new_mute_ref=new_mute_ref,
+        n_delivered=jnp.sum(accept.astype(jnp.int32)),
+        n_rejected=nrej,
+    )

@@ -1,0 +1,306 @@
+"""The dispatch step: one scheduler tick over the whole actor world, jitted.
+
+≙ the reference's hot loop (SURVEY.md §3.3): scheduler `run`
+(src/libponyrt/sched/scheduler.c:953-1090) popping actors and
+`ponyint_actor_run` (src/libponyrt/actor/actor.c:383-549) draining up to
+`batch` messages per actor through `type->dispatch`. On TPU there is no
+work-stealing — the entire world advances in lockstep:
+
+  per device cohort (actors of one type, contiguous ids):
+      gather  ≤batch messages per actor from the mailbox table
+      scan    over batch slots; per slot a `lax.switch` over the type's
+              behaviours (≙ the generated dispatch switch, genfun.c),
+              vmapped over the cohort's actors
+      collect sends / exit / yield effects functionally
+  then one global `deliver` (see delivery.py) routes every produced
+  message, and flag updates implement mute/unmute and quiescence bits.
+
+Work-stealing, victim selection and scaling-sleep (scheduler.c:485-935)
+have no TPU analog — idle actors cost one masked lane, not a core; the
+*quiescence protocol* (CNF/ACK tokens, scheduler.c:303-480) collapses to a
+reduction over mailbox occupancies returned to the host every tick.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..api import Context
+from ..config import RuntimeOptions
+from ..ops import pack
+from ..ops.segment import counts_by_key
+from ..program import Cohort, Program
+from .delivery import Entries, deliver
+from .state import RtState
+
+
+class StepAux(NamedTuple):
+    """Small per-step scalars fetched by the host driver (≙ the scheduler's
+    control-message reads + quiescence vote, scheduler.c:303-480)."""
+    device_pending: jnp.ndarray  # bool — any device mailbox/spill work left
+    host_pending: jnp.ndarray    # bool — host-cohort mailboxes non-empty
+    exit_flag: jnp.ndarray       # bool — some behaviour called ctx.exit
+    exit_code: jnp.ndarray       # int32
+    spill_overflow: jnp.ndarray  # bool — fatal: spill buffer exceeded
+    n_processed: jnp.ndarray     # int32 — *cumulative* behaviours run
+    n_delivered: jnp.ndarray     # int32 — *cumulative* deliveries
+    # (cumulative = state counters; the host accumulates mod-2^32 deltas,
+    # so fetches may be arbitrarily far apart as long as fewer than 2^31
+    # events occur between two fetches.)
+
+
+def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes):
+    """Wrap one behaviour into a switch branch with canonical outputs."""
+    w1 = 1 + msg_words
+
+    def branch(operand):
+        st, payload, actor_id = operand
+        ctx = Context(actor_id, msg_words)
+        args = pack.unpack_args(bdef.arg_specs, payload)
+        st2 = bdef.fn(ctx, dict(st), *args)
+        if st2 is None:
+            raise TypeError(
+                f"behaviour {bdef} must return the (possibly updated) state "
+                "dict")
+        if set(st2.keys()) != set(st.keys()):
+            raise TypeError(
+                f"behaviour {bdef} changed the state fields: "
+                f"{sorted(st2)} vs {sorted(st)}")
+        st2 = {k: jnp.asarray(v, field_dtypes[k]) for k, v in st2.items()}
+        if len(ctx.sends) > max_sends:
+            raise RuntimeError(
+                f"behaviour {bdef} performs {len(ctx.sends)} sends but the "
+                f"type's send budget is {max_sends}; set MAX_SENDS = "
+                f"{len(ctx.sends)} on the actor class")
+        tgts, words = [], []
+        for (t, w, when) in ctx.sends:
+            tgts.append(jnp.where(when, t, jnp.int32(-1)))
+            words.append(w)
+        for _ in range(max_sends - len(ctx.sends)):
+            tgts.append(jnp.int32(-1))
+            words.append(jnp.zeros((w1,), jnp.int32))
+        tgt_arr = jnp.stack(tgts) if tgts else jnp.zeros((0,), jnp.int32)
+        words_arr = (jnp.stack(words) if words
+                     else jnp.zeros((0, w1), jnp.int32))
+        return (st2, (tgt_arr, words_arr),
+                (ctx.exit_flag, ctx.exit_code), ctx.yield_flag)
+
+    return branch
+
+
+def _make_noop_branch(msg_words: int, max_sends: int):
+    w1 = 1 + msg_words
+
+    def branch(operand):
+        st, _payload, _actor_id = operand
+        return (dict(st),
+                (jnp.full((max_sends,), -1, jnp.int32),
+                 jnp.zeros((max_sends, w1), jnp.int32)),
+                (jnp.bool_(False), jnp.int32(0)),
+                jnp.bool_(False))
+
+    return branch
+
+
+def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
+    """Build the vmapped per-actor drain loop for one cohort.
+
+    ≙ ponyint_actor_run (actor.c:383-549): pop ≤batch app messages,
+    dispatch each, honour yield (fork: actor.c:675-679), count consumption.
+    """
+    msg_words = opts.msg_words
+    ms = cohort.max_sends
+    batch = cohort.batch
+    field_dtypes = {}
+    for fname, spec in cohort.atype.field_specs.items():
+        field_dtypes[fname] = (jnp.float32 if spec is pack.F32
+                               else jnp.int32)
+    branches = [_make_branch(b, msg_words, ms, field_dtypes)
+                for b in cohort.behaviours]
+    branches.append(_make_noop_branch(msg_words, ms))
+    nb = len(cohort.behaviours)
+    base = cohort.behaviours[0].global_id if nb else 0
+
+    def actor_fn(st_row, msgs, valids, actor_id):
+        # msgs: [batch, 1+W]; valids: [batch] bool.
+        def scan_body(carry, x):
+            st, stopped, ef, ec, nproc, nbad = carry
+            msg, valid = x
+            local = msg[0] - base
+            in_range = (local >= 0) & (local < nb)
+            do = valid & ~stopped
+            bid = jnp.where(do & in_range, local, nb)
+            st2, (stgt, swords), (bef, bec), yf = lax.switch(
+                bid, branches, (st, msg[1:], actor_id))
+            new_ef = ef | bef
+            new_ec = jnp.where(bef & ~ef, bec, ec)
+            stopped2 = stopped if noyield else (stopped | yf)
+            return ((st2, stopped2, new_ef, new_ec,
+                     nproc + (do & in_range).astype(jnp.int32),
+                     nbad + (do & ~in_range).astype(jnp.int32)),
+                    (stgt, swords, do))
+
+        carry0 = (st_row, jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
+                  jnp.int32(0), jnp.int32(0))
+        (stf, _, ef, ec, nproc, nbad), (stgt, swords, consumed) = lax.scan(
+            scan_body, carry0, (msgs, valids))
+        n_consumed = jnp.sum(consumed.astype(jnp.int32))
+        return stf, (stgt, swords), ef, ec, nproc, nbad, n_consumed
+
+    vfn = jax.vmap(actor_fn)
+
+    def run_cohort(type_state_row, buf_rows, head_rows, occ_rows,
+                   runnable_rows):
+        n_run = jnp.where(runnable_rows,
+                          jnp.minimum(occ_rows, batch), 0)
+        k = jnp.arange(batch, dtype=jnp.int32)
+        idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
+        msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
+        valids = k[None, :] < n_run[:, None]
+        ids = (cohort.start +
+               jnp.arange(cohort.capacity, dtype=jnp.int32))
+        stf, (stgt, swords), ef, ec, nproc, nbad, n_consumed = vfn(
+            type_state_row, msgs, valids, ids)
+        # Flatten the outbox: [cap*batch*ms] entries in (actor, slot, send)
+        # order — exactly a sender's causal emission order.
+        e = cohort.capacity * batch * ms
+        sender = jnp.repeat(ids, batch * ms)
+        out = Entries(tgt=stgt.reshape(e),
+                      sender=sender,
+                      words=swords.reshape(e, -1))
+        any_exit = jnp.any(ef)
+        code = ec[jnp.argmax(ef)]
+        return (stf, out, head_rows + n_consumed, any_exit, code,
+                jnp.sum(nproc), jnp.sum(nbad))
+
+    return run_cohort
+
+
+def build_step(program: Program, opts: RuntimeOptions):
+    """Trace one whole-world scheduler tick; returns a jittable fn
+    step(state, inject_tgt, inject_words) → (state, StepAux)."""
+    assert program.frozen
+    n = program.total
+    c = opts.mailbox_cap
+    fh = program.first_host_id
+    dev_cohorts = program.device_cohorts
+    dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield), ch)
+                   for ch in dev_cohorts]
+
+    def step(st: RtState, inject_tgt, inject_words
+             ) -> Tuple[RtState, StepAux]:
+        occ0 = st.tail - st.head
+
+        # --- 1. unmute pass (≙ ponyint_sched_unmute_senders,
+        # scheduler.c:1552-1635: receiver recovered → senders released).
+        sp_valid = st.spill_tgt >= 0
+        spill_pending = counts_by_key(
+            jnp.minimum(jnp.maximum(st.spill_tgt, 0), n - 1),
+            sp_valid.astype(jnp.int32), n)
+        has_ref = st.mute_ref >= 0
+        mr = jnp.minimum(jnp.maximum(st.mute_ref, 0), n - 1)
+        release = st.muted & (
+            ~has_ref | ((occ0[mr] <= opts.unmute_occ)
+                        & (spill_pending[mr] == 0)))
+        muted = st.muted & ~release
+        mute_ref = jnp.where(release, -1, st.mute_ref)
+
+        # --- 2. drain + dispatch per cohort (≙ actor run loop).
+        runnable = st.alive & ~muted
+        new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
+        head_segments: List[jnp.ndarray] = []
+        out_entries: List[Entries] = []
+        exit_f = st.exit_flag
+        exit_c = st.exit_code
+        nproc_total = jnp.int32(0)
+        nbad_total = jnp.int32(0)
+        for run_cohort, ch in dispatchers:
+            s0, s1 = ch.start, ch.stop
+            stf, out, new_head_rows, ef, ec, nproc, nbad = run_cohort(
+                st.type_state[ch.atype.__name__],
+                st.buf[s0:s1], st.head[s0:s1], occ0[s0:s1],
+                runnable[s0:s1])
+            new_type_state[ch.atype.__name__] = stf
+            head_segments.append(new_head_rows)
+            out_entries.append(out)
+            exit_c = jnp.where(ef & ~exit_f, ec, exit_c)
+            exit_f = exit_f | ef
+            nproc_total = nproc_total + nproc
+            nbad_total = nbad_total + nbad
+        if fh < n:  # host-cohort heads unchanged by device dispatch
+            head_segments.append(st.head[fh:n])
+        new_head = (jnp.concatenate(head_segments) if head_segments
+                    else st.head)
+
+        # --- 3. assemble this tick's in-flight messages:
+        # oldest spill first, then host injections, then fresh outbox.
+        spill_e = Entries(st.spill_tgt, st.spill_sender, st.spill_words)
+        inject_e = Entries(inject_tgt,
+                           jnp.full_like(inject_tgt, n), inject_words)
+        all_e = Entries(
+            tgt=jnp.concatenate([spill_e.tgt, inject_e.tgt]
+                                + [o.tgt for o in out_entries]),
+            sender=jnp.concatenate([spill_e.sender, inject_e.sender]
+                                   + [o.sender for o in out_entries]),
+            words=jnp.concatenate([spill_e.words, inject_e.words]
+                                  + [o.words for o in out_entries]),
+        )
+        # Sends to dead slots are dropped (the reference's type system makes
+        # this unrepresentable — ORCA keeps receivers alive; here it is a
+        # counted dynamic error: n_deadletter).
+        tgt_clip = jnp.minimum(jnp.maximum(all_e.tgt, 0), n - 1)
+        to_dead = (all_e.tgt >= 0) & (all_e.tgt < n) & ~st.alive[tgt_clip]
+        n_dead = jnp.sum(to_dead.astype(jnp.int32))
+        all_e = all_e._replace(tgt=jnp.where(to_dead, -1, all_e.tgt))
+
+        # --- 4. delivery (the batched pony_sendv; see delivery.py).
+        res = deliver(st.buf, new_head, st.tail, all_e,
+                      num_actors=n, mailbox_cap=c,
+                      spill_cap=opts.spill_cap,
+                      overload_occ=opts.overload_occ)
+
+        # --- 5. mute bookkeeping (≙ ponyint_mute_actor, actor.c:1171-1207).
+        became_muted = res.newly_muted & ~muted
+        muted2 = muted | res.newly_muted
+        mute_ref2 = jnp.where(res.newly_muted, res.new_mute_ref, mute_ref)
+
+        occ_after = res.tail - new_head
+        device_pending = jnp.any(occ_after[:fh] > 0) | (res.spill_count > 0)
+        host_pending = (jnp.any(occ_after[fh:] > 0) if fh < n
+                        else jnp.bool_(False))
+
+        st2 = RtState(
+            buf=res.buf, head=new_head, tail=res.tail,
+            alive=st.alive, muted=muted2, mute_ref=mute_ref2,
+            spill_tgt=res.spill.tgt, spill_sender=res.spill.sender,
+            spill_words=res.spill.words, spill_count=res.spill_count,
+            spill_overflow=st.spill_overflow | res.spill_overflow,
+            exit_flag=exit_f, exit_code=exit_c,
+            step_no=st.step_no + 1,
+            n_processed=st.n_processed + nproc_total,
+            n_delivered=st.n_delivered + res.n_delivered,
+            n_rejected=st.n_rejected + res.n_rejected,
+            n_badmsg=st.n_badmsg + nbad_total,
+            n_deadletter=st.n_deadletter + n_dead,
+            n_mutes=st.n_mutes + jnp.sum(became_muted.astype(jnp.int32)),
+            type_state=new_type_state,
+        )
+        aux = StepAux(
+            device_pending=device_pending,
+            host_pending=host_pending,
+            exit_flag=exit_f, exit_code=exit_c,
+            spill_overflow=st2.spill_overflow,
+            n_processed=st2.n_processed,
+            n_delivered=st2.n_delivered,
+        )
+        return st2, aux
+
+    return step
+
+
+def jit_step(program: Program, opts: RuntimeOptions):
+    return jax.jit(build_step(program, opts), donate_argnums=(0,))

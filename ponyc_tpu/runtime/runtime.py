@@ -1,0 +1,363 @@
+"""Host driver: runtime construction, spawning, host↔device messaging and
+the run-to-quiescence loop.
+
+≙ the reference's runtime bootstrap and lifecycle
+(src/libponyrt/sched/start.c: pony_init parses flags and sizes the world,
+pony_start runs schedulers until quiescence, pony_get_exitcode returns the
+program's code) plus the host side of actor creation
+(pony_create, actor/actor.c:688-734) and external sends (pony_sendv from
+non-actor context).
+
+The host loop is deliberately thin: it dispatches `quiesce_interval` jitted
+steps at a time (XLA runs them asynchronously), then reads back a handful
+of scalars to decide termination — the TPU analog of the CNF/ACK quiescence
+vote (scheduler.c:303-480). Host-resident actors (HOST=True types — the
+main-thread/ASIO-side actors of the reference, scheduler.c:179-190,
+asio/asio.c) are drained at those same boundaries.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import ActorTypeMeta, BehaviourDef
+from ..config import RuntimeOptions
+from ..ops import pack
+from ..program import Program
+from . import engine
+from .state import RtState, init_state
+
+
+class SpillOverflowError(RuntimeError):
+    """The bounded overflow spill was exceeded — raise mailbox_cap or
+    spill_cap, or let backpressure mute faster (lower overload_threshold)."""
+
+
+class HostContext:
+    """Effect collector for host-resident behaviours (≙ running an actor on
+    the main-thread scheduler, scheduler.c:1030-1035)."""
+
+    def __init__(self, rt: "Runtime", actor_id: int):
+        self.rt = rt
+        self.actor_id = actor_id
+        self.exit_flag = False
+        self.exit_code = 0
+        self.yield_flag = False
+
+    def send(self, target, behaviour_def, *args, when=True):
+        if when:
+            self.rt.send(int(target), behaviour_def, *args)
+
+    def exit(self, code=0, when=True):
+        if when:
+            self.exit_flag = True
+            self.exit_code = int(code)
+
+    def yield_(self, when=True):
+        if when:
+            self.yield_flag = True
+
+
+def _host_pack_args(specs, args, msg_words):
+    words = np.zeros((msg_words,), np.int32)
+    if len(args) != len(specs):
+        raise TypeError(f"behaviour takes {len(specs)} args, got {len(args)}")
+    for i, (spec, v) in enumerate(zip(specs, args)):
+        if spec is pack.F32:
+            words[i] = np.float32(v).view(np.int32)
+        elif spec is pack.Bool:
+            words[i] = np.int32(bool(v))
+        else:
+            words[i] = np.int32(v)
+    return words
+
+
+def _host_unpack_args(specs, words):
+    out = []
+    for i, spec in enumerate(specs):
+        w = np.int32(words[i])
+        if spec is pack.F32:
+            out.append(float(w.view(np.float32)))
+        elif spec is pack.Bool:
+            out.append(bool(w))
+        else:
+            out.append(int(w))
+    return tuple(out)
+
+
+class Runtime:
+    """A live actor world bound to one program layout.
+
+    Typical use::
+
+        rt = Runtime(opts)
+        rt.declare(RingNode, 1024)
+        rt.start()                       # ≙ pony_init: freeze + allocate
+        refs = rt.spawn_many(RingNode, next_ref=..., passes=...)
+        rt.send(refs[0], RingNode.token, 1000)
+        code = rt.run()                  # ≙ pony_start: run to quiescence
+    """
+
+    def __init__(self, opts: Optional[RuntimeOptions] = None):
+        self.opts = opts or RuntimeOptions()
+        self.program = Program(self.opts)
+        self.state: Optional[RtState] = None
+        self._step = None
+        self._inject_q: collections.deque = collections.deque()
+        self._free: Dict[str, List[int]] = {}
+        self._host_state: Dict[int, Dict[str, Any]] = {}
+        self._exit_code = 0
+        self._exit_requested = False
+        self._noisy = 0          # ≙ asio noisy_count keeping runtime alive
+        self._bridge_pollers: List[Any] = []   # asio backends (bridge/)
+        self.steps_run = 0
+        self.totals = collections.Counter()    # lifetime stats (host ints)
+        self._last_counters: Dict[str, int] = {}
+
+    # ---- construction (≙ pony_init) ----
+    def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
+        self.program.declare(atype, capacity)
+        return self
+
+    def start(self) -> "Runtime":
+        self.program.finalize()
+        self.state = init_state(self.program, self.opts)
+        self._step = engine.jit_step(self.program, self.opts)
+        w1 = 1 + self.opts.msg_words
+        k = self.opts.inject_slots
+        self._empty_inject = (jnp.full((k,), -1, jnp.int32),
+                              jnp.zeros((k, w1), jnp.int32))
+        for cohort in self.program.cohorts:
+            self._free[cohort.atype.__name__] = list(
+                range(cohort.capacity - 1, -1, -1))
+        return self
+
+    # ---- spawning (≙ pony_create, actor.c:688-734) ----
+    def spawn(self, atype: ActorTypeMeta, **fields) -> int:
+        return int(self.spawn_many(atype, 1, **{
+            k: np.asarray([v]) for k, v in fields.items()})[0])
+
+    def spawn_many(self, atype: ActorTypeMeta, count: int,
+                   **fields) -> np.ndarray:
+        """Allocate `count` slots of a cohort and set initial state columns.
+
+        Field values may be scalars (broadcast) or [count] arrays. Returns
+        the global actor ids. This is the host-side mass-create path the
+        benchmarks use (the reference creates actors one pony_create at a
+        time; batch creation is the idiomatic TPU equivalent).
+        """
+        if self.state is None:
+            raise RuntimeError("call start() before spawn()")
+        cohort = self.program.by_type[atype]
+        unknown = set(fields) - set(atype.field_specs)
+        if unknown:
+            raise TypeError(f"{atype.__name__} has no fields {unknown}")
+        free = self._free[atype.__name__]
+        if len(free) < count:
+            raise RuntimeError(
+                f"cohort {atype.__name__} capacity exhausted "
+                f"({cohort.capacity} declared)")
+        slots = np.array([free.pop() for _ in range(count)], np.int32)
+        ids = cohort.start + slots
+        if cohort.host:
+            for i, slot in enumerate(slots):
+                st = {}
+                for fname in atype.field_specs:
+                    v = fields.get(fname, 0)
+                    v = np.asarray(v)
+                    st[fname] = v.reshape(-1)[i % max(v.size, 1)].item() \
+                        if v.ndim else v.item()
+                self._host_state[int(cohort.start + slot)] = st
+        else:
+            ts = dict(self.state.type_state[atype.__name__])
+            for fname, spec in atype.field_specs.items():
+                if fname in fields:
+                    val = jnp.asarray(fields[fname]).astype(ts[fname].dtype)
+                    val = jnp.broadcast_to(val, (count,) if val.ndim == 0
+                                           else val.shape)
+                    ts[fname] = ts[fname].at[slots].set(val)
+            new_ts = dict(self.state.type_state)
+            new_ts[atype.__name__] = ts
+            self.state = self._replace(type_state=new_ts)
+        self.state = self._replace(
+            alive=self.state.alive.at[ids].set(True))
+        return ids
+
+    def _replace(self, **kw) -> RtState:
+        import dataclasses as _dc
+        return _dc.replace(self.state, **kw)
+
+    def set_fields(self, atype: ActorTypeMeta, ids, **fields):
+        """Overwrite state columns for existing actors (host-side poke,
+        e.g. wiring refs once ids are known). ids are global actor ids."""
+        cohort = self.program.by_type[atype]
+        slots = jnp.asarray(np.asarray(ids) - cohort.start)
+        if cohort.host:
+            for i, aid in enumerate(np.asarray(ids).reshape(-1)):
+                st = self._host_state.setdefault(int(aid), {})
+                for fname, v in fields.items():
+                    v = np.asarray(v).reshape(-1)
+                    st[fname] = v[i % v.size].item()
+            return
+        ts = dict(self.state.type_state[atype.__name__])
+        for fname, v in fields.items():
+            col = ts[fname]
+            val = jnp.asarray(v).astype(col.dtype)
+            ts[fname] = col.at[slots].set(val)
+        new_ts = dict(self.state.type_state)
+        new_ts[atype.__name__] = ts
+        self.state = self._replace(type_state=new_ts)
+
+    # ---- external sends (≙ pony_sendv from outside the runtime) ----
+    def send(self, target: int, behaviour_def: BehaviourDef, *args):
+        if behaviour_def.global_id is None:
+            raise RuntimeError(f"{behaviour_def} not part of this program")
+        words = np.zeros((1 + self.opts.msg_words,), np.int32)
+        words[0] = behaviour_def.global_id
+        words[1:] = _host_pack_args(behaviour_def.arg_specs, args,
+                                    self.opts.msg_words)
+        self._inject_q.append((int(target), words))
+
+    def _drain_inject(self):
+        if not self._inject_q:
+            return self._empty_inject
+        k = self.opts.inject_slots
+        w1 = 1 + self.opts.msg_words
+        tgt = np.full((k,), -1, np.int32)
+        words = np.zeros((k, w1), np.int32)
+        for i in range(min(k, len(self._inject_q))):
+            t, w = self._inject_q.popleft()
+            tgt[i] = t
+            words[i] = w
+        return jnp.asarray(tgt), jnp.asarray(words)
+
+    # ---- asio bridge hooks (≙ asio/asio.c noisy accounting) ----
+    def add_noisy(self):
+        self._noisy += 1
+
+    def remove_noisy(self):
+        self._noisy = max(0, self._noisy - 1)
+
+    def register_poller(self, poller):
+        """poller.poll(rt) is called at every host boundary; it may inject
+        messages (timers/sockets/stdin — the bridge package uses this)."""
+        self._bridge_pollers.append(poller)
+
+    # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
+    def _drain_host(self) -> bool:
+        fh, n = self.program.first_host_id, self.program.total
+        if fh >= n:
+            return False
+        head = np.asarray(self.state.head[fh:])
+        tail = np.asarray(self.state.tail[fh:])
+        pending = tail - head
+        if not pending.any():
+            return False
+        buf = np.asarray(self.state.buf[fh:])
+        c = self.opts.mailbox_cap
+        new_head = head.copy()
+        for i in np.nonzero(pending)[0]:
+            aid = fh + int(i)
+            cohort = self.program.cohort_of(aid)
+            consumed = 0
+            for k in range(int(pending[i])):
+                msg = buf[i, (head[i] + k) % c]
+                consumed += 1
+                gid = int(msg[0])
+                bdef = (self.program.behaviour_table[gid]
+                        if 0 <= gid < len(self.program.behaviour_table)
+                        else None)
+                if bdef is None or bdef.actor_type is not cohort.atype:
+                    self.totals["badmsg"] += 1
+                    continue
+                ctx = HostContext(self, aid)
+                st = self._host_state.get(aid, {})
+                args = _host_unpack_args(bdef.arg_specs, msg[1:])
+                st2 = bdef.fn(ctx, st, *args)
+                self._host_state[aid] = st2 if st2 is not None else st
+                self.totals["host_processed"] += 1
+                if ctx.exit_flag:
+                    self._exit_code = ctx.exit_code
+                    self._exit_requested = True
+                if ctx.yield_flag:
+                    break
+            new_head[i] = head[i] + consumed
+        self.state = self._replace(
+            head=self.state.head.at[fh:].set(jnp.asarray(new_head)))
+        return True
+
+    # ---- the run loop (≙ pony_start → scheduler run → quiescence) ----
+    def run(self, max_steps: Optional[int] = None) -> int:
+        if self.state is None:
+            raise RuntimeError("call start() first")
+        self._exit_requested = False
+        max_steps = max_steps or self.opts.max_steps
+        qi = max(1, self.opts.quiesce_interval)
+        idle_polls = 0
+        steps_this_run = 0
+        while True:
+            aux = None
+            for _ in range(qi):
+                inj = self._drain_inject()
+                self.state, aux = self._step(self.state, *inj)
+                self.steps_run += 1
+                steps_this_run += 1
+            a = jax.device_get(aux)
+            # aux counters are cumulative int32; accumulate mod-2^32 deltas
+            # so fetch cadence doesn't matter (< 2^31 events per window).
+            for key, cur in (("processed", int(a.n_processed) & 0xFFFFFFFF),
+                             ("delivered", int(a.n_delivered) & 0xFFFFFFFF)):
+                last = self._last_counters.get(key, 0)
+                self.totals[key] += (cur - last) & 0xFFFFFFFF
+                self._last_counters[key] = cur
+            if bool(a.spill_overflow):
+                raise SpillOverflowError(
+                    f"spill overflow at step {self.steps_run}")
+            if bool(a.exit_flag):
+                self._exit_code = int(a.exit_code)
+                break
+            if bool(a.host_pending):
+                self._drain_host()
+            for p in self._bridge_pollers:
+                p.poll(self)
+            if self._exit_requested:
+                break
+            busy = (bool(a.device_pending) or bool(a.host_pending)
+                    or bool(self._inject_q))
+            if not busy:
+                if self._noisy == 0 and not self._bridge_pollers:
+                    break  # quiescent: terminate (≙ ACK'd CNF token)
+                idle_polls += 1
+                if self._noisy == 0 and idle_polls > 2:
+                    break
+            else:
+                idle_polls = 0
+            if max_steps is not None and steps_this_run >= max_steps:
+                break
+        return self._exit_code
+
+    # ---- introspection (≙ ponyint_actor_num_messages, actor.c:666; and
+    # the analysis dump hooks, analysis.c) ----
+    def queue_depth(self, actor_id: int) -> int:
+        return int(self.state.tail[actor_id] - self.state.head[actor_id])
+
+    def state_of(self, actor_id: int) -> Dict[str, Any]:
+        cohort = self.program.cohort_of(actor_id)
+        if cohort.host:
+            return dict(self._host_state.get(actor_id, {}))
+        slot = actor_id - cohort.start
+        ts = self.state.type_state[cohort.atype.__name__]
+        return {k: np.asarray(v[slot]).item() for k, v in ts.items()}
+
+    def cohort_state(self, atype: ActorTypeMeta) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.state.type_state[atype.__name__].items()}
+
+    @property
+    def exit_code(self) -> int:
+        return self._exit_code

@@ -1,0 +1,113 @@
+"""Device-resident runtime state: the struct-of-arrays actor world.
+
+≙ the reference's per-actor structs flattened across all actors:
+  - pony_actor_t fields (flags, priority, batch, mute counters —
+    src/libponyrt/actor/actor.h:35-69) become columns over [N] actors;
+  - each actor's messageq_t (intrusive MPSC list, actor/messageq.c) becomes
+    one row of a dense [N, cap, words] ring-buffer table with monotonically
+    increasing head/tail counts (occupancy = tail - head; physical slot =
+    count % cap);
+  - the scheduler's unbounded pool-backed queues have no static-shape
+    analog, so overflow goes to a bounded *spill* table retried next step
+    (SURVEY.md §7 hard part (a): capacity-bounded mailboxes with spill).
+
+Everything lives in one pytree so a whole scheduler tick is a single jitted
+function application; host↔device traffic per step is a handful of scalars.
+
+Counts are int32: a single actor overflows after 2^31 lifetime messages —
+acceptable for now, and noted here deliberately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..config import RuntimeOptions
+from ..program import Program
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RtState:
+    """The complete device state of the actor world (one pytree)."""
+
+    # Mailboxes (≙ messageq.c): one row per actor, device and host cohorts.
+    buf: jnp.ndarray          # [N, cap, 1+W] int32 — word0 = behaviour gid
+    head: jnp.ndarray         # [N] int32, monotonic pop count
+    tail: jnp.ndarray         # [N] int32, monotonic push count
+
+    # Per-actor scheduling flags (≙ actor.h:59-69 flag bits).
+    alive: jnp.ndarray        # [N] bool — slot occupied (≙ !PENDINGDESTROY)
+    muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
+    mute_ref: jnp.ndarray     # [N] int32 — the receiver that muted us (-1)
+
+    # Overflow spill (bounded; retried first every step, preserving order).
+    spill_tgt: jnp.ndarray    # [S] int32 target id, -1 = empty slot
+    spill_sender: jnp.ndarray  # [S] int32 sender id (N = host/no sender)
+    spill_words: jnp.ndarray  # [S, 1+W] int32
+    spill_count: jnp.ndarray  # [] int32
+    spill_overflow: jnp.ndarray  # [] bool — spill itself overflowed (fatal)
+
+    # Program-wide control (≙ pony_exitcode / quiescence token state).
+    exit_flag: jnp.ndarray    # [] bool
+    exit_code: jnp.ndarray    # [] int32
+    step_no: jnp.ndarray      # [] int32
+
+    # Telemetry accumulators, reset by host on fetch (≙ --ponyanalysis
+    # counters, analysis.c; i32 windows accumulated to python ints host-side).
+    n_processed: jnp.ndarray  # [] int32 — behaviours dispatched
+    n_delivered: jnp.ndarray  # [] int32 — messages accepted into mailboxes
+    n_rejected: jnp.ndarray   # [] int32 — capacity rejections (→ spill)
+    n_badmsg: jnp.ndarray     # [] int32 — wrong-type behaviour ids dropped
+    n_deadletter: jnp.ndarray  # [] int32 — sends to dead/unspawned slots
+    n_mutes: jnp.ndarray      # [] int32 — mute transitions
+
+    # Per-type state columns: {type_name: {field: [cap_T] array}}.
+    type_state: Dict[str, Dict[str, jnp.ndarray]]
+
+
+def init_state(program: Program, opts: RuntimeOptions) -> RtState:
+    """Allocate the zeroed actor world for a finalized program."""
+    assert program.frozen, "finalize() the Program first"
+    n = program.total
+    w1 = 1 + opts.msg_words
+    c = opts.mailbox_cap
+    s = opts.spill_cap
+    i32 = jnp.int32
+
+    type_state: Dict[str, Dict[str, Any]] = {}
+    for cohort in program.cohorts:
+        fields = {}
+        for fname, spec in cohort.atype.field_specs.items():
+            from ..ops.pack import F32
+            dtype = jnp.float32 if spec is F32 else jnp.int32
+            fields[fname] = jnp.zeros((cohort.capacity,), dtype)
+        type_state[cohort.atype.__name__] = fields
+
+    return RtState(
+        buf=jnp.zeros((n, c, w1), i32),
+        head=jnp.zeros((n,), i32),
+        tail=jnp.zeros((n,), i32),
+        alive=jnp.zeros((n,), jnp.bool_),
+        muted=jnp.zeros((n,), jnp.bool_),
+        mute_ref=jnp.full((n,), -1, i32),
+        spill_tgt=jnp.full((s,), -1, i32),
+        spill_sender=jnp.full((s,), n, i32),
+        spill_words=jnp.zeros((s, w1), i32),
+        spill_count=jnp.zeros((), i32),
+        spill_overflow=jnp.zeros((), jnp.bool_),
+        exit_flag=jnp.zeros((), jnp.bool_),
+        exit_code=jnp.zeros((), i32),
+        step_no=jnp.zeros((), i32),
+        n_processed=jnp.zeros((), i32),
+        n_delivered=jnp.zeros((), i32),
+        n_rejected=jnp.zeros((), i32),
+        n_badmsg=jnp.zeros((), i32),
+        n_deadletter=jnp.zeros((), i32),
+        n_mutes=jnp.zeros((), i32),
+        type_state=type_state,
+    )

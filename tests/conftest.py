@@ -1,0 +1,15 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX import.
+
+≙ the reference's fake-stdlib/PassTest fixture strategy (test/libponyc/
+util.h:32-82): tests run against a controllable substrate rather than the
+real target. Multi-chip sharding tests use these 8 virtual devices; the
+real TPU is exercised only by bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"   # override the env's axon default
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

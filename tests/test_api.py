@@ -1,0 +1,85 @@
+"""API-surface semantics: behaviour identity under inheritance, repeated
+run() calls, dead-letter accounting, flag parsing."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (Actor, I32, Ref, Runtime, RuntimeOptions, actor,
+                       behaviour, strip_runtime_flags)
+
+OPTS = RuntimeOptions(mailbox_cap=8, batch=2, max_sends=1, msg_words=1)
+
+
+class Base(Actor):
+    count: I32
+
+    @behaviour
+    def bump(self, st, by: I32):
+        return {**st, "count": st["count"] + by}
+
+
+class A(Base):
+    pass
+
+
+class B(Base):
+    @behaviour
+    def bump(self, st, by: I32):   # override: doubles
+        return {**st, "count": st["count"] + 2 * by}
+
+
+def test_inherited_behaviours_get_distinct_dispatch_slots():
+    rt = Runtime(OPTS)
+    rt.declare(A, 2).declare(B, 2)
+    rt.start()
+    a = rt.spawn(A)
+    b = rt.spawn(B)
+    assert A.bump is not B.bump and A.bump is not Base.bump
+    rt.send(a, A.bump, 5)
+    rt.send(b, B.bump, 5)
+    rt.run(max_steps=20)
+    assert rt.state_of(a)["count"] == 5
+    assert rt.state_of(b)["count"] == 10
+    assert rt.totals["processed"] == 2
+
+
+def test_run_twice_and_counter_totals():
+    rt = Runtime(OPTS)
+    rt.declare(A, 1)
+    rt.start()
+    a = rt.spawn(A)
+    for _ in range(3):
+        rt.send(a, A.bump, 1)
+    rt.run(max_steps=50)
+    first = rt.steps_run
+    assert rt.state_of(a)["count"] == 3
+    # Second run must not be starved by the lifetime step counter.
+    for _ in range(3):
+        rt.send(a, A.bump, 1)
+    rt.run(max_steps=50)
+    assert rt.state_of(a)["count"] == 6
+    assert rt.steps_run > first
+    assert rt.totals["processed"] == 6
+
+
+def test_deadletter_counted():
+    rt = Runtime(OPTS)
+    rt.declare(A, 2)
+    rt.start()
+    a = rt.spawn(A)          # second slot never spawned
+    dead = a + 1 if a + 1 < 2 else a - 1
+    rt.send(dead, A.bump, 1)
+    rt.run(max_steps=10)
+    assert int(rt.state.n_deadletter) == 1
+
+
+def test_strip_runtime_flags():
+    opts, rest = strip_runtime_flags(
+        ["prog", "--pony_mailbox_cap", "128", "--ponybatch=16",
+         "--ponynoyield", "user-arg"])
+    assert opts.mailbox_cap == 128
+    assert opts.batch == 16
+    assert opts.noyield is True
+    assert rest == ["prog", "user-arg"]
+    with pytest.raises(ValueError):
+        strip_runtime_flags(["prog", "--pony_batch"])
