@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark: message-ubench throughput on one chip.
+
+Reproduces the reference's `examples/message-ubench` metric
+(actor-messages/sec; BASELINE.md) at benchmark scale: N pingers in one
+shuffled cycle, one message in flight per actor, sustained. Each jitted
+tick dispatches exactly N behaviours and routes N messages, so
+
+    msgs/sec = N × ticks / elapsed.
+
+vs_baseline: the reference publishes no absolute numbers (BASELINE.md —
+"published: {}"); the driver-set north star is ≥10× message-ubench on a
+32-core CPU. We use 3.0e8 msgs/s as the 32-core CPU estimate (Pony's
+ubench sustains O(10M) msgs/core/s on modern x86), so vs_baseline 10.0
+== the north-star 10× target.
+
+Usage: python bench.py  [--actors N] [--ticks K] (defaults 2^20, 200)
+Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS override.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CPU32_BASELINE_MSGS_PER_SEC = 3.0e8
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_ACTORS",
+                                               1 << 20)))
+    ap.add_argument("--ticks", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
+    ap.add_argument("--warmup", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ubench
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                          spill_cap=1024, inject_slots=8)
+    t0 = time.time()
+    rt, ids = ubench.build(args.actors, opts)
+    ubench.seed_all(rt, ids, hops=1 << 30)   # effectively infinite
+    build_s = time.time() - t0
+
+    # Drive the jitted tick directly (the run() loop's quiescence polling
+    # is for applications; the bench measures the engine's steady state).
+    inj = rt._empty_inject
+    state = rt.state
+    t0 = time.time()
+    for _ in range(args.warmup):
+        state, aux = rt._step(state, *inj)
+    jax.block_until_ready(aux)
+    warm_s = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(args.ticks):
+        state, aux = rt._step(state, *inj)
+    jax.block_until_ready(aux)
+    elapsed = time.time() - t0
+    rt.state = state
+
+    processed = rt.counter("n_processed") & 0xFFFFFFFF
+    expect = (args.warmup + args.ticks) * args.actors
+    msgs_per_sec = args.actors * args.ticks / elapsed
+
+    result = {
+        "metric": "ubench_actor_messages_per_sec",
+        "value": round(msgs_per_sec, 1),
+        "unit": "msgs/sec/chip",
+        "vs_baseline": round(msgs_per_sec / CPU32_BASELINE_MSGS_PER_SEC, 3),
+        "detail": {
+            "actors": args.actors,
+            "ticks": args.ticks,
+            "elapsed_s": round(elapsed, 4),
+            "tick_ms": round(1e3 * elapsed / args.ticks, 3),
+            "processed_counter_ok": bool(processed == expect % (1 << 32)),
+            "build_s": round(build_s, 1),
+            "warmup_s": round(warm_s, 1),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""gups — port of the reference benchmark `examples/gups_basic/main.pony`
+(RandomAccess/GUPS: random xor-updates scattered over a distributed table
+held by actors).
+
+TPU shape: the table is one cohort with *one word per actor* (the
+actor-per-element limit case of the reference's actor-partitioned table —
+scatter delivery IS the random-access operation), plus an updater cohort.
+Each updater carries a xorshift32 PRNG in its state, picks a random table
+actor every tick and fires an `update(val)` at it; delivery's sort+scatter
+performs the GUP. Throughput in updates/sec ≙ GUPS.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+
+@actor
+class TableCell:
+    value: I32
+
+    @behaviour
+    def update(self, st, v: I32):
+        return {**st, "value": st["value"] ^ v}
+
+
+@actor
+class Updater:
+    rng: I32
+    table_base: I32
+    table_size: I32
+    done: I32
+
+    BATCH = 1
+    MAX_SENDS = 2
+
+    @behaviour
+    def tick(self, st, n: I32):
+        # xorshift32 (public-domain Marsaglia generator).
+        x = st["rng"]
+        x = x ^ (x << 13)
+        x = x ^ ((x >> 17) & 0x7FFF)
+        x = x ^ (x << 5)
+        idx = jabs(x) % st["table_size"]
+        self.send(st["table_base"] + idx, TableCell.update, x, when=n > 0)
+        self.send(self.actor_id, Updater.tick, n - 1, when=n > 1)
+        return {**st, "rng": x, "done": st["done"] + (n > 0)}
+
+
+def jabs(x):
+    import jax.numpy as jnp
+    return jnp.where(x < 0, -x, x)
+
+
+def build(table_size: int = 4096, n_updaters: int = 64,
+          opts: RuntimeOptions | None = None):
+    opts = opts or RuntimeOptions(mailbox_cap=16, batch=2, msg_words=1,
+                                  spill_cap=1024)
+    rt = Runtime(opts)
+    rt.declare(TableCell, table_size).declare(Updater, n_updaters)
+    rt.start()
+    cells = rt.spawn_many(TableCell, table_size)
+    rng = np.random.default_rng(7)
+    upd = rt.spawn_many(
+        Updater, n_updaters,
+        rng=rng.integers(1, 2**31 - 1, n_updaters),
+        table_base=np.full(n_updaters, cells.min()),
+        table_size=table_size)
+    return rt, cells, upd
+
+
+def run(table_size: int = 4096, n_updaters: int = 64, updates_each: int = 32,
+        opts: RuntimeOptions | None = None) -> Runtime:
+    rt, cells, upd = build(table_size, n_updaters, opts)
+    rt.bulk_send(upd, Updater.tick, [updates_each] * n_updaters)
+    rt.run(max_steps=updates_each * 4 + 200)
+    return rt

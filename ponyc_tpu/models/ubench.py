@@ -1,0 +1,59 @@
+"""message-ubench — port of the reference's headline throughput benchmark
+(`examples/message-ubench/main.pony`: N pinger actors continuously
+exchanging ping messages; the metric is actor-messages/sec).
+
+TPU shape: pingers are one cohort; each pinger holds a `next_ref` (a
+shuffled permutation so traffic is irregular, like the reference's random
+pings) and on `ping(n)` forwards `ping(n-1)` while n > 0. Seeding every
+pinger once yields a sustained load of exactly N in-flight messages — one
+dispatched message per actor per tick, which is the framework's peak
+message throughput (BASELINE.md north star: ≥10× a 32-core CPU at 1M
+actors on one chip).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+
+@actor
+class Pinger:
+    next_ref: Ref
+    pings: I32
+
+    BATCH = 1
+    MAX_SENDS = 1
+
+    @behaviour
+    def ping(self, st, n: I32):
+        self.send(st["next_ref"], Pinger.ping, n - 1, when=n > 0)
+        return {**st, "pings": st["pings"] + 1}
+
+
+def build(n_pingers: int, opts: RuntimeOptions | None = None,
+          permute: bool = True, seed: int = 0):
+    opts = opts or RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                  msg_words=1)
+    rt = Runtime(opts)
+    rt.declare(Pinger, n_pingers)
+    rt.start()
+    ids = rt.spawn_many(Pinger, n_pingers)
+    if permute:
+        rng = np.random.default_rng(seed)
+        # A single random cycle over all pingers: irregular traffic but
+        # every mailbox receives exactly one message per tick (sustained,
+        # no hotspots — the steady state the reference's ubench reaches).
+        order = rng.permutation(n_pingers)
+        nxt = np.empty(n_pingers, np.int64)
+        nxt[order] = ids[np.roll(order, -1)]
+    else:
+        nxt = np.roll(ids, -1)
+    rt.set_fields(Pinger, ids, next_ref=nxt)
+    return rt, ids
+
+
+def seed_all(rt: Runtime, ids, hops: int):
+    """Give every pinger an initial ping carrying `hops` remaining."""
+    rt.bulk_send(ids, Pinger.ping, np.full(len(ids), hops, np.int64))

@@ -1,0 +1,5 @@
+"""Mesh/sharding layer: the scale-out axis the single-process reference
+never had (SURVEY.md §2.4) — actor rows shard over an 'actors' mesh axis,
+messages route via all_to_all collectives over ICI/DCN."""
+
+from .mesh import make_mesh, shard_state  # noqa: F401

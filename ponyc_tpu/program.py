@@ -25,13 +25,24 @@ from .config import RuntimeOptions
 
 
 class Cohort:
-    """A contiguous id-range of actors of one type (≙ one reach_type_t)."""
+    """The actors of one type (≙ one reach_type_t).
 
-    def __init__(self, atype: ActorTypeMeta, start: int, capacity: int,
-                 opts: RuntimeOptions):
+    Id layout is *shard-major, cohort-minor* so the same static per-shard
+    slicing works on every mesh shard (see Program docstring): global actor
+    id = shard * n_local + local_start + (slot // shards), where `slot` is
+    the cohort-relative slot (slot % shards picks the shard, round-robin
+    for balance). With shards == 1 this degenerates to the contiguous
+    [start, stop) range.
+    """
+
+    def __init__(self, atype: ActorTypeMeta, capacity: int,
+                 opts: RuntimeOptions, shards: int):
         self.atype = atype
-        self.start = start
-        self.capacity = capacity            # max live actors of this type
+        self.shards = shards
+        # Round capacity up so every shard holds the same number of rows.
+        self.capacity = -(-capacity // shards) * shards
+        self.local_capacity = self.capacity // shards
+        self.local_start = 0        # per-shard row offset; set by finalize()
         self.batch = atype.BATCH or opts.batch
         self.priority = atype.PRIORITY
         self.host = bool(atype.HOST)
@@ -41,14 +52,34 @@ class Cohort:
         # trace, not silently at run.
         self.max_sends = getattr(atype, "MAX_SENDS", None) or opts.max_sends
         self.behaviours = list(atype.behaviour_defs)
+        self.n_local_total = 0      # rows per shard over all cohorts (set later)
+
+    def slot_to_gid(self, slot):
+        """Cohort slot → global actor id (vectorised, numpy-friendly)."""
+        shard = slot % self.shards
+        row = self.local_start + slot // self.shards
+        return shard * self.n_local_total + row
+
+    def slot_to_col(self, slot):
+        """Cohort slot → row in this cohort's [capacity] state columns
+        (shard-major so the column array shards cleanly on its leading
+        axis)."""
+        shard = slot % self.shards
+        return shard * self.local_capacity + slot // self.shards
+
+    def gid_to_col(self, gid):
+        """Global actor id → state-column row (vectorised)."""
+        shard = gid // self.n_local_total
+        row = gid % self.n_local_total - self.local_start
+        return shard * self.local_capacity + row
 
     @property
-    def stop(self) -> int:
-        return self.start + self.capacity
+    def local_stop(self) -> int:
+        return self.local_start + self.local_capacity
 
     def __repr__(self):
-        return (f"<cohort {self.atype.__name__} ids=[{self.start},"
-                f"{self.stop}) batch={self.batch}>")
+        return (f"<cohort {self.atype.__name__} cap={self.capacity}"
+                f"×{self.shards}sh batch={self.batch}>")
 
 
 class Program:
@@ -62,11 +93,13 @@ class Program:
 
     def __init__(self, opts: Optional[RuntimeOptions] = None):
         self.opts = opts or RuntimeOptions()
+        self.shards = max(1, self.opts.mesh_shards)
         self._declared: List[Tuple[ActorTypeMeta, int]] = []
         self.cohorts: List[Cohort] = []
         self.by_type: Dict[ActorTypeMeta, Cohort] = {}
         self.behaviour_table: List = []   # global id → BehaviourDef
         self.total = 0
+        self.n_local = 0                  # actor rows per shard
         self.frozen = False
 
     def declare(self, atype: ActorTypeMeta, capacity: int):
@@ -82,17 +115,26 @@ class Program:
     def finalize(self) -> "Program":
         if self.frozen:
             return self
-        # Host cohorts last: their ids sit in a contiguous tail range so the
-        # device delivery can classify "host-bound" with one compare
+        # Host cohorts last: their rows sit in a contiguous per-shard tail
+        # range so delivery can classify "host-bound" with one compare
         # (≙ inject_main diverting use_main_thread actors, scheduler.c:179).
+        if self.shards > 1 and any(t.HOST for t, _ in self._declared):
+            raise NotImplementedError(
+                "HOST=True actor types are not yet supported on a "
+                "multi-shard mesh; keep host actors on a single-chip "
+                "runtime")
         self._declared.sort(key=lambda tc: bool(tc[0].HOST))
         offset = 0
         for atype, cap in self._declared:
-            cohort = Cohort(atype, offset, cap, self.opts)
+            cohort = Cohort(atype, cap, self.opts, self.shards)
+            cohort.local_start = offset
+            offset += cohort.local_capacity
             self.cohorts.append(cohort)
             self.by_type[atype] = cohort
-            offset += cap
-        self.total = offset
+        self.n_local = offset
+        self.total = offset * self.shards
+        for cohort in self.cohorts:
+            cohort.n_local_total = self.n_local
         gid = 0
         for cohort in self.cohorts:
             for local, bdef in enumerate(cohort.behaviours):
@@ -112,16 +154,26 @@ class Program:
         return [c for c in self.cohorts if c.host]
 
     @property
-    def first_host_id(self) -> int:
-        """Ids >= this are host-resident actors (tail range), or total if
-        there are none."""
+    def first_host_row(self) -> int:
+        """Per-shard rows >= this belong to host-resident actors (tail
+        range), or n_local if there are none."""
         for c in self.cohorts:
             if c.host:
-                return c.start
-        return self.total
+                return c.local_start
+        return self.n_local
 
     def cohort_of(self, actor_id: int) -> Cohort:
+        if not 0 <= actor_id < self.total:
+            raise IndexError(
+                f"actor id {actor_id} out of range [0,{self.total})")
+        row = actor_id % self.n_local
         for c in self.cohorts:
-            if c.start <= actor_id < c.stop:
+            if c.local_start <= row < c.local_stop:
                 return c
-        raise IndexError(f"actor id {actor_id} out of range [0,{self.total})")
+        raise IndexError(f"actor id {actor_id} maps to no cohort")
+
+    def gid_to_slot(self, actor_id: int) -> int:
+        """Inverse of Cohort.slot_to_gid."""
+        c = self.cohort_of(actor_id)
+        shard, row = divmod(actor_id, self.n_local)
+        return (row - c.local_start) * self.shards + shard

@@ -1,26 +1,31 @@
-"""Batched message delivery: the vectorised send path.
+"""Batched message delivery: the vectorised send path (shard-local).
 
 ≙ the reference's pony_sendv → ponyint_maybe_mute → messageq_push →
 ponyint_sched_add chain (src/libponyrt/actor/actor.c:773-968,
-actor/messageq.c:102-160), executed for *every in-flight message at once*:
+actor/messageq.c:102-160), executed for *every in-flight message at once*
+within one shard of the actor world:
 
-  1. gather all candidate messages for this tick — spill (oldest first),
-     host injections, then this step's freshly produced outbox;
-  2. stable-sort by target id: per-target arrival order is then
-     [older spill → inject → outbox-in-sender-slot-order], which preserves
-     the per-sender→receiver FIFO guarantee Pony gives (messageq FIFO +
-     causal send order; SURVEY.md §7 hard part (c)) because a sender whose
-     message was rejected is muted until its spill drains, so it can never
-     emit a *newer* message that would overtake an older spilled one;
+  1. the engine hands over all candidate messages for this tick whose
+     target rows live on this shard — receiver-side spill (oldest first),
+     host injections, then freshly routed/produced messages;
+  2. stable-sort by target row: per-target arrival order is then
+     [older spill → inject → new-in-emission-order], which preserves the
+     per-sender→receiver FIFO guarantee Pony gives (messageq FIFO + causal
+     send order; SURVEY.md §7 hard part (c)) because a sender whose message
+     was rejected is muted until its spill drains, so it can never emit a
+     *newer* message that would overtake an older spilled one;
   3. rank each message within its target segment; accept while
      rank < free-space (rejections are therefore always the newest suffix
      per target, keeping FIFO safe);
   4. one scatter writes all accepted payloads into the mailbox table;
-  5. rejections are stably compacted into the next spill buffer and their
-     senders muted (≙ ponyint_maybe_mute: mute on sending to an overloaded/
-     muted receiver, actor.c:898-921 — here "receiver rejected or is over
-     the occupancy threshold", the static-shape analog of the reference's
-     batch-exhaustion OVERLOADED flag, actor.c:369-381).
+  5. rejections are stably compacted into the next spill buffer, and their
+     *locally resident* senders muted (≙ ponyint_maybe_mute: mute on
+     sending to an overloaded/muted receiver, actor.c:898-921 — here
+     "receiver rejected or is over the occupancy threshold", the
+     static-shape analog of the reference's batch-exhaustion OVERLOADED
+     flag, actor.c:369-381). Remote senders are not muted by receiver-side
+     rejection yet; their messages still park in this shard's spill, so no
+     ordering guarantee is lost — only the throttling hint is weaker.
 """
 
 from __future__ import annotations
@@ -34,31 +39,39 @@ from ..ops.segment import (compact_mask, counts_by_key, segment_ranks,
 
 
 class Entries(NamedTuple):
-    """A flat batch of in-flight messages."""
-    tgt: jnp.ndarray      # [E] int32 target actor id; -1 = empty slot
-    sender: jnp.ndarray   # [E] int32 sender id; >=N means "no sender" (host)
+    """A flat batch of in-flight messages (targets in *local rows* here;
+    the routing layer in engine.py deals in global ids)."""
+    tgt: jnp.ndarray      # [E] int32 target row; -1 = empty slot
+    sender: jnp.ndarray   # [E] int32 sender *global* id; -1 = host/no sender
     words: jnp.ndarray    # [E, 1+W] int32 (word0 = behaviour gid)
 
 
 class DeliveryResult(NamedTuple):
     buf: jnp.ndarray
     tail: jnp.ndarray
-    spill: Entries        # rejected entries, compacted, oldest first
-    spill_count: jnp.ndarray
+    spill: Entries             # rejected entries, compacted, oldest first
+    spill_count: jnp.ndarray   # [] int32
     spill_overflow: jnp.ndarray
-    newly_muted: jnp.ndarray   # [N] bool
-    new_mute_ref: jnp.ndarray  # [N] int32 (-1 where not newly muted)
+    newly_muted: jnp.ndarray   # [n_local] bool (local senders only)
+    new_mute_ref: jnp.ndarray  # [n_local] int32 global ref (-1 none)
     n_delivered: jnp.ndarray
     n_rejected: jnp.ndarray
+    n_deadletter: jnp.ndarray
 
 
-def deliver(buf, head, tail, entries: Entries, *, num_actors: int,
-            mailbox_cap: int, spill_cap: int, overload_occ: int
-            ) -> DeliveryResult:
-    n, c = num_actors, mailbox_cap
+def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
+            mailbox_cap: int, spill_cap: int, overload_occ: int,
+            shard_base) -> DeliveryResult:
+    n, c = n_local, mailbox_cap
     tgt, sender, words = entries
 
-    valid = (tgt >= 0) & (tgt < n)
+    in_range = (tgt >= 0) & (tgt < n)
+    tgt_c = jnp.minimum(jnp.maximum(tgt, 0), n - 1)
+    # Sends to dead slots drop with a counter (the reference's type system
+    # makes this unrepresentable — ORCA keeps receivers alive).
+    to_dead = in_range & ~alive[tgt_c]
+    valid = in_range & ~to_dead
+
     key = jnp.where(valid, tgt, n).astype(jnp.int32)
     perm = stable_sort_by(key)
     kt = key[perm]
@@ -75,8 +88,7 @@ def deliver(buf, head, tail, entries: Entries, *, num_actors: int,
     slot = (tail[ktc] + rank) % c
     scatter_row = jnp.where(accept, kt, n)          # row n → dropped
     buf = buf.at[scatter_row, slot].set(wds, mode="drop")
-    acc_counts = counts_by_key(ktc, accept.astype(jnp.int32) *
-                               ok.astype(jnp.int32), n)
+    acc_counts = counts_by_key(ktc, accept.astype(jnp.int32), n)
     new_tail = tail + acc_counts
     occ_after = new_tail - head
 
@@ -85,26 +97,28 @@ def deliver(buf, head, tail, entries: Entries, *, num_actors: int,
     perm2, vspill, nrej = compact_mask(rej, spill_cap)
     spill = Entries(
         tgt=jnp.where(vspill, kt[perm2], -1),
-        sender=jnp.where(vspill, snd[perm2], n),
+        sender=jnp.where(vspill, snd[perm2], -1),
         words=jnp.where(vspill[:, None], wds[perm2], 0),
     )
     spill_overflow = nrej > spill_cap
 
     # Mute triggers (≙ actor.c:898-921 + mute rules actor.c:1171-1235):
-    # a *valid, actor-originated* send whose receiver rejected it or is now
-    # over the overload threshold mutes the sender — unless the sender is
-    # itself overloaded (the reference's !OVERLOADED/UNDER_PRESSURE guard,
-    # which prevents mute deadlocks among hot actors).
+    # a valid send whose receiver rejected it or is now over the overload
+    # threshold mutes the sender — unless the sender is itself overloaded
+    # (the reference's !OVERLOADED/UNDER_PRESSURE guard, which prevents
+    # mute deadlocks among hot actors). Only senders resident on this
+    # shard can be muted here.
     recv_hot = occ_after[ktc] > overload_occ
-    has_sender = (snd >= 0) & (snd < n)
-    sc = jnp.minimum(jnp.maximum(snd, 0), n - 1)
+    lsnd = snd - shard_base
+    sender_local = (lsnd >= 0) & (lsnd < n)
+    sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
     sender_hot = (new_tail[sc] - head[sc]) > overload_occ
-    trig = ok & has_sender & (rej | recv_hot) & ~sender_hot
+    trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
     mute_row = jnp.where(trig, sc, n)
     newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
         trig, mode="drop")
     new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
-        jnp.where(trig, kt, -1), mode="drop")
+        jnp.where(trig, kt + shard_base, -1), mode="drop")
 
     return DeliveryResult(
         buf=buf, tail=new_tail,
@@ -113,4 +127,5 @@ def deliver(buf, head, tail, entries: Entries, *, num_actors: int,
         newly_muted=newly_muted, new_mute_ref=new_mute_ref,
         n_delivered=jnp.sum(accept.astype(jnp.int32)),
         n_rejected=nrej,
+        n_deadletter=jnp.sum(to_dead.astype(jnp.int32)),
     )

@@ -6,19 +6,29 @@
 `batch` messages per actor through `type->dispatch`. On TPU there is no
 work-stealing — the entire world advances in lockstep:
 
-  per device cohort (actors of one type, contiguous ids):
+  per device cohort (actors of one type, contiguous per-shard rows):
       gather  ≤batch messages per actor from the mailbox table
       scan    over batch slots; per slot a `lax.switch` over the type's
               behaviours (≙ the generated dispatch switch, genfun.c),
               vmapped over the cohort's actors
       collect sends / exit / yield effects functionally
-  then one global `deliver` (see delivery.py) routes every produced
-  message, and flag updates implement mute/unmute and quiescence bits.
+  route   (mesh only) bucket every produced message by target shard and
+          exchange with one `lax.all_to_all` over the ICI — the
+          communication backend the single-process reference never needed
+          (SURVEY.md §2.4); bucket overflow parks messages in the sender
+          shard's route-spill, muting the sender
+  deliver one stable sort + scatter per shard writes every message whose
+          target lives here (see delivery.py), mute/unmute updates
+  vote    quiescence = psum over shards of pending-work bits — the
+          collective analog of the CNF/ACK token protocol
+          (scheduler.c:303-480)
 
 Work-stealing, victim selection and scaling-sleep (scheduler.c:485-935)
-have no TPU analog — idle actors cost one masked lane, not a core; the
-*quiescence protocol* (CNF/ACK tokens, scheduler.c:303-480) collapses to a
-reduction over mailbox occupancies returned to the host every tick.
+have no TPU analog — idle actors cost one masked lane, not a core.
+
+The same traced function serves single-chip (P=1: no collectives, plain
+jit) and meshed execution (shard_map over an 'actors' axis); per-shard
+"scalars" are [1]-shaped so local and global layouts coincide.
 """
 
 from __future__ import annotations
@@ -32,7 +42,7 @@ from jax import lax
 from ..api import Context
 from ..config import RuntimeOptions
 from ..ops import pack
-from ..ops.segment import counts_by_key
+from ..ops.segment import compact_mask, counts_by_key, segment_ranks
 from ..program import Cohort, Program
 from .delivery import Entries, deliver
 from .state import RtState
@@ -40,12 +50,13 @@ from .state import RtState
 
 class StepAux(NamedTuple):
     """Small per-step scalars fetched by the host driver (≙ the scheduler's
-    control-message reads + quiescence vote, scheduler.c:303-480)."""
+    control-message reads + quiescence vote, scheduler.c:303-480). All
+    entries are mesh-wide aggregates (replicated when sharded)."""
     device_pending: jnp.ndarray  # bool — any device mailbox/spill work left
     host_pending: jnp.ndarray    # bool — host-cohort mailboxes non-empty
     exit_flag: jnp.ndarray       # bool — some behaviour called ctx.exit
     exit_code: jnp.ndarray       # int32
-    spill_overflow: jnp.ndarray  # bool — fatal: spill buffer exceeded
+    spill_overflow: jnp.ndarray  # bool — fatal: a spill buffer exceeded
     n_processed: jnp.ndarray     # int32 — *cumulative* behaviours run
     n_delivered: jnp.ndarray     # int32 — *cumulative* deliveries
     # (cumulative = state counters; the host accumulates mod-2^32 deltas,
@@ -153,21 +164,19 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
 
     vfn = jax.vmap(actor_fn)
 
-    def run_cohort(type_state_row, buf_rows, head_rows, occ_rows,
-                   runnable_rows):
+    def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
+                   runnable_rows, ids):
         n_run = jnp.where(runnable_rows,
                           jnp.minimum(occ_rows, batch), 0)
         k = jnp.arange(batch, dtype=jnp.int32)
         idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
         msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
         valids = k[None, :] < n_run[:, None]
-        ids = (cohort.start +
-               jnp.arange(cohort.capacity, dtype=jnp.int32))
         stf, (stgt, swords), ef, ec, nproc, nbad, n_consumed = vfn(
-            type_state_row, msgs, valids, ids)
-        # Flatten the outbox: [cap*batch*ms] entries in (actor, slot, send)
-        # order — exactly a sender's causal emission order.
-        e = cohort.capacity * batch * ms
+            type_state_rows, msgs, valids, ids)
+        # Flatten the outbox: (actor, slot, send) order — exactly a
+        # sender's causal emission order.
+        e = cohort.local_capacity * batch * ms
         sender = jnp.repeat(ids, batch * ms)
         out = Entries(tgt=stgt.reshape(e),
                       sender=sender,
@@ -180,32 +189,116 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
     return run_cohort
 
 
+def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
+           rspill_cap: int, overload_occ, head, tail, shard_base):
+    """Mesh routing: pack entries into per-destination-shard buckets and
+    exchange them with one all_to_all over the actor axis (ICI).
+
+    Returns (received Entries [shards*bucket], new route-spill, spill count,
+    overflow flag, newly muted [n_local], their refs). Bucket overflow keeps
+    messages on the source shard (route-spill, retried first next step) and
+    mutes the sender — backpressure across the mesh without any
+    receiver-side state (≙ the intent of ponyint_maybe_mute; the occupancy
+    signal here is "the link to that shard is saturated").
+    """
+    tgt, sender, words = entries
+    e = tgt.shape[0]
+    valid = tgt >= 0
+    dest = jnp.where(valid, tgt // n_local, shards).astype(jnp.int32)
+    perm = jnp.argsort(dest, stable=True)
+    dt = dest[perm]
+    ok = dt < shards
+    rank = segment_ranks(dt)
+    accept = ok & (rank < bucket)
+
+    dtc = jnp.minimum(dt, shards - 1)
+    slot = dtc * bucket + rank
+    slot = jnp.where(accept, slot, shards * bucket)  # OOB → dropped
+    bt = jnp.full((shards * bucket,), -1, jnp.int32).at[slot].set(
+        tgt[perm], mode="drop")
+    bs = jnp.full((shards * bucket,), -1, jnp.int32).at[slot].set(
+        sender[perm], mode="drop")
+    bw = jnp.zeros((shards * bucket, words.shape[1]), jnp.int32).at[
+        slot].set(words[perm], mode="drop")
+
+    rt = lax.all_to_all(bt, "actors", split_axis=0, concat_axis=0,
+                        tiled=True)
+    rs = lax.all_to_all(bs, "actors", split_axis=0, concat_axis=0,
+                        tiled=True)
+    rw = lax.all_to_all(bw, "actors", split_axis=0, concat_axis=0,
+                        tiled=True)
+
+    # Bucket overflow → route spill (stays on this shard, ordered).
+    rej = ok & ~accept
+    perm2, vsp, nrej = compact_mask(rej, rspill_cap)
+    new_rspill = Entries(
+        tgt=jnp.where(vsp, tgt[perm][perm2], -1),
+        sender=jnp.where(vsp, sender[perm][perm2], -1),
+        words=jnp.where(vsp[:, None], words[perm][perm2], 0),
+    )
+    # Mute the (always local) senders of parked messages; ref = target.
+    lsnd = sender[perm] - shard_base
+    s_ok = rej & (lsnd >= 0) & (lsnd < n_local)
+    sc = jnp.minimum(jnp.maximum(lsnd, 0), n_local - 1)
+    s_hot = (tail[sc] - head[sc]) > overload_occ
+    trig = s_ok & ~s_hot
+    mute_row = jnp.where(trig, sc, n_local)
+    newly_muted = jnp.zeros((n_local,), jnp.bool_).at[mute_row].max(
+        trig, mode="drop")
+    new_ref = jnp.full((n_local,), -1, jnp.int32).at[mute_row].max(
+        jnp.where(trig, tgt[perm], -1), mode="drop")
+
+    received = Entries(tgt=rt, sender=rs, words=rw)
+    return (received, new_rspill, jnp.minimum(nrej, rspill_cap),
+            nrej > rspill_cap, newly_muted, new_ref)
+
+
 def build_step(program: Program, opts: RuntimeOptions):
-    """Trace one whole-world scheduler tick; returns a jittable fn
-    step(state, inject_tgt, inject_words) → (state, StepAux)."""
+    """Trace one whole-world scheduler tick; returns a function
+    local_step(state, inject_tgt, inject_words) → (state, StepAux) in
+    *per-shard* coordinates. Wrap with jit (P=1) or shard_map (P>1) via
+    jit_step()."""
     assert program.frozen
-    n = program.total
+    p = program.shards
+    nl = program.n_local
     c = opts.mailbox_cap
-    fh = program.first_host_id
+    fh = program.first_host_row
+    s_cap = opts.spill_cap
     dev_cohorts = program.device_cohorts
     dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield), ch)
                    for ch in dev_cohorts]
+    # all_to_all bucket size: worst case one shard receives everything;
+    # keep buckets at outbox-size/shards ×4 (tunable; overflow is safe).
+    e_out = sum(ch.local_capacity * ch.batch * ch.max_sends
+                for ch in dev_cohorts)
+    bucket = max(16, min(e_out + s_cap, 4 * (e_out + s_cap) // p))
 
-    def step(st: RtState, inject_tgt, inject_words
-             ) -> Tuple[RtState, StepAux]:
+    def local_step(st: RtState, inject_tgt, inject_words
+                   ) -> Tuple[RtState, StepAux]:
+        if p > 1:
+            shard = lax.axis_index("actors").astype(jnp.int32)
+        else:
+            shard = jnp.int32(0)
+        base = shard * nl
         occ0 = st.tail - st.head
 
         # --- 1. unmute pass (≙ ponyint_sched_unmute_senders,
         # scheduler.c:1552-1635: receiver recovered → senders released).
-        sp_valid = st.spill_tgt >= 0
-        spill_pending = counts_by_key(
-            jnp.minimum(jnp.maximum(st.spill_tgt, 0), n - 1),
-            sp_valid.astype(jnp.int32), n)
+        dsp_valid = st.dspill_tgt >= 0
+        dspill_pending = counts_by_key(
+            jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
+            dsp_valid.astype(jnp.int32), nl)
         has_ref = st.mute_ref >= 0
-        mr = jnp.minimum(jnp.maximum(st.mute_ref, 0), n - 1)
-        release = st.muted & (
-            ~has_ref | ((occ0[mr] <= opts.unmute_occ)
-                        & (spill_pending[mr] == 0)))
+        lref = st.mute_ref - base
+        ref_local = (lref >= 0) & (lref < nl)
+        mr = jnp.minimum(jnp.maximum(lref, 0), nl - 1)
+        local_ok = (ref_local & (occ0[mr] <= opts.unmute_occ)
+                    & (dspill_pending[mr] == 0))
+        # Remote muting ref: release once this shard's route-spill drained
+        # (the local evidence of congestion is gone; receiver-side pressure
+        # will re-mute via routing if it persists).
+        remote_ok = (~ref_local) & (st.rspill_count[0] == 0)
+        release = st.muted & (~has_ref | local_ok | remote_ok)
         muted = st.muted & ~release
         mute_ref = jnp.where(release, -1, st.mute_ref)
 
@@ -214,16 +307,17 @@ def build_step(program: Program, opts: RuntimeOptions):
         new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
         head_segments: List[jnp.ndarray] = []
         out_entries: List[Entries] = []
-        exit_f = st.exit_flag
-        exit_c = st.exit_code
+        exit_f = st.exit_flag[0]
+        exit_c = st.exit_code[0]
         nproc_total = jnp.int32(0)
         nbad_total = jnp.int32(0)
         for run_cohort, ch in dispatchers:
-            s0, s1 = ch.start, ch.stop
+            s0, s1 = ch.local_start, ch.local_stop
+            ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
             stf, out, new_head_rows, ef, ec, nproc, nbad = run_cohort(
                 st.type_state[ch.atype.__name__],
                 st.buf[s0:s1], st.head[s0:s1], occ0[s0:s1],
-                runnable[s0:s1])
+                runnable[s0:s1], ids)
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
             out_entries.append(out)
@@ -231,76 +325,158 @@ def build_step(program: Program, opts: RuntimeOptions):
             exit_f = exit_f | ef
             nproc_total = nproc_total + nproc
             nbad_total = nbad_total + nbad
-        if fh < n:  # host-cohort heads unchanged by device dispatch
-            head_segments.append(st.head[fh:n])
+        if fh < nl:  # host-cohort heads unchanged by device dispatch
+            head_segments.append(st.head[fh:nl])
         new_head = (jnp.concatenate(head_segments) if head_segments
                     else st.head)
 
-        # --- 3. assemble this tick's in-flight messages:
-        # oldest spill first, then host injections, then fresh outbox.
-        spill_e = Entries(st.spill_tgt, st.spill_sender, st.spill_words)
-        inject_e = Entries(inject_tgt,
-                           jnp.full_like(inject_tgt, n), inject_words)
-        all_e = Entries(
-            tgt=jnp.concatenate([spill_e.tgt, inject_e.tgt]
-                                + [o.tgt for o in out_entries]),
-            sender=jnp.concatenate([spill_e.sender, inject_e.sender]
-                                   + [o.sender for o in out_entries]),
-            words=jnp.concatenate([spill_e.words, inject_e.words]
-                                  + [o.words for o in out_entries]),
+        # --- 3. route (mesh) or pass through (single chip).
+        rspill_e = Entries(st.rspill_tgt, st.rspill_sender, st.rspill_words)
+        out_cat = Entries(
+            tgt=jnp.concatenate([rspill_e.tgt] +
+                                [o.tgt for o in out_entries]),
+            sender=jnp.concatenate([rspill_e.sender] +
+                                   [o.sender for o in out_entries]),
+            words=jnp.concatenate([rspill_e.words] +
+                                  [o.words for o in out_entries]),
         )
-        # Sends to dead slots are dropped (the reference's type system makes
-        # this unrepresentable — ORCA keeps receivers alive; here it is a
-        # counted dynamic error: n_deadletter).
-        tgt_clip = jnp.minimum(jnp.maximum(all_e.tgt, 0), n - 1)
-        to_dead = (all_e.tgt >= 0) & (all_e.tgt < n) & ~st.alive[tgt_clip]
-        n_dead = jnp.sum(to_dead.astype(jnp.int32))
-        all_e = all_e._replace(tgt=jnp.where(to_dead, -1, all_e.tgt))
+        route_muted = jnp.zeros((nl,), jnp.bool_)
+        route_ref = jnp.full((nl,), -1, jnp.int32)
+        if p > 1:
+            (incoming, new_rspill, rsp_count, rsp_over, route_muted,
+             route_ref) = _route(
+                out_cat, shards=p, n_local=nl, bucket=bucket,
+                rspill_cap=s_cap, overload_occ=opts.overload_occ,
+                head=new_head, tail=st.tail, shard_base=base)
+            incoming = incoming._replace(
+                tgt=jnp.where(incoming.tgt >= 0, incoming.tgt - base, -1))
+        else:
+            incoming = out_cat._replace(
+                tgt=jnp.where(out_cat.tgt >= 0, out_cat.tgt - base, -1))
+            new_rspill = Entries(st.rspill_tgt, st.rspill_sender,
+                                 st.rspill_words)   # unused, stays empty
+            rsp_count = st.rspill_count[0]
+            rsp_over = jnp.bool_(False)
 
-        # --- 4. delivery (the batched pony_sendv; see delivery.py).
-        res = deliver(st.buf, new_head, st.tail, all_e,
-                      num_actors=n, mailbox_cap=c,
-                      spill_cap=opts.spill_cap,
-                      overload_occ=opts.overload_occ)
+        # --- 4. delivery list: receiver spill first (oldest), then host
+        # injections, then routed messages. Injections are replicated to
+        # all shards; each shard keeps only rows it owns.
+        inj_l = inject_tgt - base
+        inj_local = jnp.where((inj_l >= 0) & (inj_l < nl), inj_l, -1)
+        dspill_e = Entries(st.dspill_tgt, st.dspill_sender, st.dspill_words)
+        all_e = Entries(
+            tgt=jnp.concatenate([dspill_e.tgt, inj_local, incoming.tgt]),
+            sender=jnp.concatenate([dspill_e.sender,
+                                    jnp.full_like(inj_local, -1),
+                                    incoming.sender]),
+            words=jnp.concatenate([dspill_e.words, inject_words,
+                                   incoming.words]),
+        )
+
+        res = deliver(st.buf, new_head, st.tail, st.alive, all_e,
+                      n_local=nl, mailbox_cap=c, spill_cap=s_cap,
+                      overload_occ=opts.overload_occ, shard_base=base)
 
         # --- 5. mute bookkeeping (≙ ponyint_mute_actor, actor.c:1171-1207).
-        became_muted = res.newly_muted & ~muted
-        muted2 = muted | res.newly_muted
-        mute_ref2 = jnp.where(res.newly_muted, res.new_mute_ref, mute_ref)
+        newly = res.newly_muted | route_muted
+        new_ref = jnp.maximum(res.new_mute_ref, route_ref)
+        became_muted = newly & ~muted
+        muted2 = muted | newly
+        mute_ref2 = jnp.where(newly, new_ref, mute_ref)
 
         occ_after = res.tail - new_head
-        device_pending = jnp.any(occ_after[:fh] > 0) | (res.spill_count > 0)
-        host_pending = (jnp.any(occ_after[fh:] > 0) if fh < n
+        local_pending = (jnp.any(occ_after[:fh] > 0)
+                         | (res.spill_count > 0) | (rsp_count > 0))
+        host_pending = (jnp.any(occ_after[fh:] > 0) if fh < nl
                         else jnp.bool_(False))
+        overflow = res.spill_overflow | rsp_over
+        if p > 1:
+            device_pending = lax.psum(
+                local_pending.astype(jnp.int32), "actors") > 0
+            exit_any = lax.psum(exit_f.astype(jnp.int32), "actors") > 0
+            exit_code_all = lax.pmax(
+                jnp.where(exit_f, exit_c, jnp.int32(-2**31)), "actors")
+            exit_code_all = jnp.where(exit_any, exit_code_all, exit_c)
+            overflow_any = lax.psum(
+                overflow.astype(jnp.int32), "actors") > 0
+            nproc_all = lax.psum(st.n_processed[0] + nproc_total, "actors")
+            ndel_all = lax.psum(st.n_delivered[0] + res.n_delivered,
+                                "actors")
+        else:
+            device_pending = local_pending
+            exit_any = exit_f
+            exit_code_all = exit_c
+            overflow_any = overflow
+            nproc_all = st.n_processed[0] + nproc_total
+            ndel_all = st.n_delivered[0] + res.n_delivered
+
+        def vec(x, dtype=None):   # per-shard "scalar" → [1]
+            return jnp.asarray(x, dtype).reshape(1)
 
         st2 = RtState(
             buf=res.buf, head=new_head, tail=res.tail,
             alive=st.alive, muted=muted2, mute_ref=mute_ref2,
-            spill_tgt=res.spill.tgt, spill_sender=res.spill.sender,
-            spill_words=res.spill.words, spill_count=res.spill_count,
-            spill_overflow=st.spill_overflow | res.spill_overflow,
-            exit_flag=exit_f, exit_code=exit_c,
-            step_no=st.step_no + 1,
-            n_processed=st.n_processed + nproc_total,
-            n_delivered=st.n_delivered + res.n_delivered,
-            n_rejected=st.n_rejected + res.n_rejected,
-            n_badmsg=st.n_badmsg + nbad_total,
-            n_deadletter=st.n_deadletter + n_dead,
-            n_mutes=st.n_mutes + jnp.sum(became_muted.astype(jnp.int32)),
+            dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
+            dspill_words=res.spill.words,
+            dspill_count=vec(res.spill_count),
+            rspill_tgt=new_rspill.tgt, rspill_sender=new_rspill.sender,
+            rspill_words=new_rspill.words,
+            rspill_count=vec(rsp_count),
+            spill_overflow=vec(st.spill_overflow[0] | overflow, jnp.bool_),
+            exit_flag=vec(exit_f, jnp.bool_), exit_code=vec(exit_c),
+            step_no=vec(st.step_no[0] + 1),
+            n_processed=vec(st.n_processed[0] + nproc_total),
+            n_delivered=vec(st.n_delivered[0] + res.n_delivered),
+            n_rejected=vec(st.n_rejected[0] + res.n_rejected),
+            n_badmsg=vec(st.n_badmsg[0] + nbad_total),
+            n_deadletter=vec(st.n_deadletter[0] + res.n_deadletter),
+            n_mutes=vec(st.n_mutes[0]
+                        + jnp.sum(became_muted.astype(jnp.int32))),
             type_state=new_type_state,
         )
         aux = StepAux(
             device_pending=device_pending,
             host_pending=host_pending,
-            exit_flag=exit_f, exit_code=exit_c,
-            spill_overflow=st2.spill_overflow,
-            n_processed=st2.n_processed,
-            n_delivered=st2.n_delivered,
+            exit_flag=exit_any, exit_code=exit_code_all,
+            spill_overflow=overflow_any,
+            n_processed=nproc_all,
+            n_delivered=ndel_all,
         )
         return st2, aux
 
-    return step
+    return local_step
 
 
-def jit_step(program: Program, opts: RuntimeOptions):
-    return jax.jit(build_step(program, opts), donate_argnums=(0,))
+def jit_step(program: Program, opts: RuntimeOptions, mesh=None):
+    """Jit the step; with a mesh, wrap in shard_map over the 'actors' axis.
+
+    ≙ ponyint_sched_start picking how many schedulers run
+    (scheduler.c:1273-1309) — except "schedulers" are mesh shards and the
+    assignment is static.
+    """
+    step = build_step(program, opts)
+    if program.shards == 1:
+        return jax.jit(step, donate_argnums=(0,))
+
+    from jax.sharding import PartitionSpec as P
+    assert mesh is not None, "sharded program needs a mesh"
+    sharded = P("actors")
+    repl = P()
+
+    def spec_of_state(_):
+        return sharded
+
+    state_spec = jax.tree.map(spec_of_state, _state_structure(program, opts))
+    aux_spec = StepAux(*([repl] * len(StepAux._fields)))
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(state_spec, repl, repl),
+        out_specs=(state_spec, aux_spec),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def _state_structure(program, opts):
+    """A pytree with the same structure as RtState for building specs."""
+    from .state import init_state
+    return jax.eval_shape(lambda: init_state(program, opts))

@@ -127,7 +127,13 @@ class Runtime:
     def start(self) -> "Runtime":
         self.program.finalize()
         self.state = init_state(self.program, self.opts)
-        self._step = engine.jit_step(self.program, self.opts)
+        if self.program.shards > 1:
+            from ..parallel.mesh import make_mesh, shard_state
+            self.mesh = make_mesh(self.program.shards)
+            self.state = shard_state(self.state, self.mesh)
+        else:
+            self.mesh = None
+        self._step = engine.jit_step(self.program, self.opts, self.mesh)
         w1 = 1 + self.opts.msg_words
         k = self.opts.inject_slots
         self._empty_inject = (jnp.full((k,), -1, jnp.int32),
@@ -163,16 +169,17 @@ class Runtime:
                 f"cohort {atype.__name__} capacity exhausted "
                 f"({cohort.capacity} declared)")
         slots = np.array([free.pop() for _ in range(count)], np.int32)
-        ids = cohort.start + slots
+        ids = np.asarray(cohort.slot_to_gid(slots), np.int32)
+        cols = np.asarray(cohort.slot_to_col(slots), np.int32)
         if cohort.host:
-            for i, slot in enumerate(slots):
+            for i, gid in enumerate(ids):
                 st = {}
                 for fname in atype.field_specs:
                     v = fields.get(fname, 0)
                     v = np.asarray(v)
                     st[fname] = v.reshape(-1)[i % max(v.size, 1)].item() \
                         if v.ndim else v.item()
-                self._host_state[int(cohort.start + slot)] = st
+                self._host_state[int(gid)] = st
         else:
             ts = dict(self.state.type_state[atype.__name__])
             for fname, spec in atype.field_specs.items():
@@ -180,7 +187,7 @@ class Runtime:
                     val = jnp.asarray(fields[fname]).astype(ts[fname].dtype)
                     val = jnp.broadcast_to(val, (count,) if val.ndim == 0
                                            else val.shape)
-                    ts[fname] = ts[fname].at[slots].set(val)
+                    ts[fname] = ts[fname].at[cols].set(val)
             new_ts = dict(self.state.type_state)
             new_ts[atype.__name__] = ts
             self.state = self._replace(type_state=new_ts)
@@ -196,7 +203,6 @@ class Runtime:
         """Overwrite state columns for existing actors (host-side poke,
         e.g. wiring refs once ids are known). ids are global actor ids."""
         cohort = self.program.by_type[atype]
-        slots = jnp.asarray(np.asarray(ids) - cohort.start)
         if cohort.host:
             for i, aid in enumerate(np.asarray(ids).reshape(-1)):
                 st = self._host_state.setdefault(int(aid), {})
@@ -204,11 +210,12 @@ class Runtime:
                     v = np.asarray(v).reshape(-1)
                     st[fname] = v[i % v.size].item()
             return
+        cols = jnp.asarray(cohort.gid_to_col(np.asarray(ids)))
         ts = dict(self.state.type_state[atype.__name__])
         for fname, v in fields.items():
             col = ts[fname]
             val = jnp.asarray(v).astype(col.dtype)
-            ts[fname] = col.at[slots].set(val)
+            ts[fname] = col.at[cols].set(val)
         new_ts = dict(self.state.type_state)
         new_ts[atype.__name__] = ts
         self.state = self._replace(type_state=new_ts)
@@ -222,6 +229,35 @@ class Runtime:
         words[1:] = _host_pack_args(behaviour_def.arg_specs, args,
                                     self.opts.msg_words)
         self._inject_q.append((int(target), words))
+
+    def bulk_send(self, targets, behaviour_def: BehaviourDef, *arg_cols):
+        """Mass-enqueue one message per (distinct) target directly into the
+        device mailboxes — the setup path for benchmark-scale seeding
+        (injecting 1M messages through the per-step inject buffer would
+        take thousands of steps). Targets must be unique within one call.
+        """
+        targets = np.asarray(targets, np.int64)
+        if len(np.unique(targets)) != len(targets):
+            raise ValueError("bulk_send targets must be distinct; use "
+                             "send() for repeated targets")
+        k = len(targets)
+        words = np.zeros((k, 1 + self.opts.msg_words), np.int32)
+        words[:, 0] = behaviour_def.global_id
+        specs = behaviour_def.arg_specs
+        if len(arg_cols) != len(specs):
+            raise TypeError(
+                f"behaviour takes {len(specs)} args, got {len(arg_cols)}")
+        for i, (spec, col) in enumerate(zip(specs, arg_cols)):
+            col = np.asarray(col)
+            if spec is pack.F32:
+                words[:, 1 + i] = col.astype(np.float32).view(np.int32)
+            else:
+                words[:, 1 + i] = col.astype(np.int32)
+        tail = self.state.tail
+        slot = np.asarray(tail[targets]) % self.opts.mailbox_cap
+        self.state = self._replace(
+            buf=self.state.buf.at[targets, slot].set(jnp.asarray(words)),
+            tail=tail.at[targets].add(1))
 
     def _drain_inject(self):
         if not self._inject_q:
@@ -250,7 +286,9 @@ class Runtime:
 
     # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
     def _drain_host(self) -> bool:
-        fh, n = self.program.first_host_id, self.program.total
+        # Host cohorts only exist on single-shard runtimes (P=1), where
+        # local row == global id.
+        fh, n = self.program.first_host_row, self.program.total
         if fh >= n:
             return False
         head = np.asarray(self.state.head[fh:])
@@ -346,16 +384,26 @@ class Runtime:
     def queue_depth(self, actor_id: int) -> int:
         return int(self.state.tail[actor_id] - self.state.head[actor_id])
 
+    def counter(self, name: str) -> int:
+        """Sum a per-shard runtime counter (n_processed, n_delivered,
+        n_rejected, n_badmsg, n_deadletter, n_mutes) over the mesh."""
+        return int(np.asarray(getattr(self.state, name)).sum())
+
     def state_of(self, actor_id: int) -> Dict[str, Any]:
         cohort = self.program.cohort_of(actor_id)
         if cohort.host:
             return dict(self._host_state.get(actor_id, {}))
-        slot = actor_id - cohort.start
+        col = int(cohort.gid_to_col(actor_id))
         ts = self.state.type_state[cohort.atype.__name__]
-        return {k: np.asarray(v[slot]).item() for k, v in ts.items()}
+        return {k: np.asarray(v[col]).item() for k, v in ts.items()}
 
     def cohort_state(self, atype: ActorTypeMeta) -> Dict[str, np.ndarray]:
-        return {k: np.asarray(v)
+        """State columns in *slot order* (spawn order), whatever the shard
+        layout."""
+        cohort = self.program.by_type[atype]
+        cols = np.asarray(
+            cohort.slot_to_col(np.arange(cohort.capacity)), np.int64)
+        return {k: np.asarray(v)[cols]
                 for k, v in self.state.type_state[atype.__name__].items()}
 
     @property

@@ -8,8 +8,21 @@
     increasing head/tail counts (occupancy = tail - head; physical slot =
     count % cap);
   - the scheduler's unbounded pool-backed queues have no static-shape
-    analog, so overflow goes to a bounded *spill* table retried next step
+    analog, so overflow goes to bounded *spill* tables retried next step
     (SURVEY.md §7 hard part (a): capacity-bounded mailboxes with spill).
+
+Every array is laid out so its leading axis shards over the actor-axis
+mesh (`shards` = P): actor rows are shard-major (see program.py), per-shard
+scalars are [P] vectors, and the two spill tables are per-shard [P*S]. With
+P == 1 this is exactly the single-chip layout. Two spills exist because a
+message can be stuck in two different places on a mesh:
+
+  - rspill ("route spill", sender side): the per-destination all_to_all
+    bucket was full — the message hasn't left its source shard yet; targets
+    are global ids.
+  - dspill ("delivery spill", receiver side): it reached the target shard
+    but the target mailbox was full; targets are local rows. This is the
+    only spill that exists on a single chip.
 
 Everything lives in one pytree so a whole scheduler tick is a single jitted
 function application; host↔device traffic per step is a handful of scalars.
@@ -43,30 +56,39 @@ class RtState:
     # Per-actor scheduling flags (≙ actor.h:59-69 flag bits).
     alive: jnp.ndarray        # [N] bool — slot occupied (≙ !PENDINGDESTROY)
     muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
-    mute_ref: jnp.ndarray     # [N] int32 — the receiver that muted us (-1)
+    mute_ref: jnp.ndarray     # [N] int32 — global id of the muting
+    #                              receiver; -1 none; -2 remote (see engine)
 
-    # Overflow spill (bounded; retried first every step, preserving order).
-    spill_tgt: jnp.ndarray    # [S] int32 target id, -1 = empty slot
-    spill_sender: jnp.ndarray  # [S] int32 sender id (N = host/no sender)
-    spill_words: jnp.ndarray  # [S, 1+W] int32
-    spill_count: jnp.ndarray  # [] int32
-    spill_overflow: jnp.ndarray  # [] bool — spill itself overflowed (fatal)
+    # Receiver-side overflow spill (local-row targets).
+    dspill_tgt: jnp.ndarray    # [P*S] int32 local row, -1 = empty slot
+    dspill_sender: jnp.ndarray  # [P*S] int32 sender *global* id (-1 = host)
+    dspill_words: jnp.ndarray  # [P*S, 1+W] int32
+    dspill_count: jnp.ndarray  # [P] int32
+
+    # Sender-side routing spill (global-id targets; used when P > 1).
+    rspill_tgt: jnp.ndarray    # [P*S] int32 global id, -1 = empty slot
+    rspill_sender: jnp.ndarray  # [P*S] int32 sender global id
+    rspill_words: jnp.ndarray  # [P*S, 1+W] int32
+    rspill_count: jnp.ndarray  # [P] int32
+
+    spill_overflow: jnp.ndarray  # [P] bool — a spill overflowed (fatal)
 
     # Program-wide control (≙ pony_exitcode / quiescence token state).
-    exit_flag: jnp.ndarray    # [] bool
-    exit_code: jnp.ndarray    # [] int32
-    step_no: jnp.ndarray      # [] int32
+    exit_flag: jnp.ndarray    # [P] bool
+    exit_code: jnp.ndarray    # [P] int32
+    step_no: jnp.ndarray      # [P] int32
 
-    # Telemetry accumulators, reset by host on fetch (≙ --ponyanalysis
-    # counters, analysis.c; i32 windows accumulated to python ints host-side).
-    n_processed: jnp.ndarray  # [] int32 — behaviours dispatched
-    n_delivered: jnp.ndarray  # [] int32 — messages accepted into mailboxes
-    n_rejected: jnp.ndarray   # [] int32 — capacity rejections (→ spill)
-    n_badmsg: jnp.ndarray     # [] int32 — wrong-type behaviour ids dropped
-    n_deadletter: jnp.ndarray  # [] int32 — sends to dead/unspawned slots
-    n_mutes: jnp.ndarray      # [] int32 — mute transitions
+    # Telemetry accumulators (≙ --ponyanalysis counters, analysis.c);
+    # int32 per shard, host accumulates mod-2^32 deltas.
+    n_processed: jnp.ndarray  # [P] int32 — behaviours dispatched
+    n_delivered: jnp.ndarray  # [P] int32 — messages accepted into mailboxes
+    n_rejected: jnp.ndarray   # [P] int32 — capacity rejections (→ spill)
+    n_badmsg: jnp.ndarray     # [P] int32 — wrong-type behaviour ids dropped
+    n_deadletter: jnp.ndarray  # [P] int32 — sends to dead/unspawned slots
+    n_mutes: jnp.ndarray      # [P] int32 — mute transitions
 
-    # Per-type state columns: {type_name: {field: [cap_T] array}}.
+    # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
+    # (leading axis shard-major; see Cohort.slot_to_col).
     type_state: Dict[str, Dict[str, jnp.ndarray]]
 
 
@@ -74,9 +96,10 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     """Allocate the zeroed actor world for a finalized program."""
     assert program.frozen, "finalize() the Program first"
     n = program.total
+    p = program.shards
     w1 = 1 + opts.msg_words
     c = opts.mailbox_cap
-    s = opts.spill_cap
+    s = opts.spill_cap * p
     i32 = jnp.int32
 
     type_state: Dict[str, Dict[str, Any]] = {}
@@ -95,19 +118,23 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         alive=jnp.zeros((n,), jnp.bool_),
         muted=jnp.zeros((n,), jnp.bool_),
         mute_ref=jnp.full((n,), -1, i32),
-        spill_tgt=jnp.full((s,), -1, i32),
-        spill_sender=jnp.full((s,), n, i32),
-        spill_words=jnp.zeros((s, w1), i32),
-        spill_count=jnp.zeros((), i32),
-        spill_overflow=jnp.zeros((), jnp.bool_),
-        exit_flag=jnp.zeros((), jnp.bool_),
-        exit_code=jnp.zeros((), i32),
-        step_no=jnp.zeros((), i32),
-        n_processed=jnp.zeros((), i32),
-        n_delivered=jnp.zeros((), i32),
-        n_rejected=jnp.zeros((), i32),
-        n_badmsg=jnp.zeros((), i32),
-        n_deadletter=jnp.zeros((), i32),
-        n_mutes=jnp.zeros((), i32),
+        dspill_tgt=jnp.full((s,), -1, i32),
+        dspill_sender=jnp.full((s,), -1, i32),
+        dspill_words=jnp.zeros((s, w1), i32),
+        dspill_count=jnp.zeros((p,), i32),
+        rspill_tgt=jnp.full((s,), -1, i32),
+        rspill_sender=jnp.full((s,), -1, i32),
+        rspill_words=jnp.zeros((s, w1), i32),
+        rspill_count=jnp.zeros((p,), i32),
+        spill_overflow=jnp.zeros((p,), jnp.bool_),
+        exit_flag=jnp.zeros((p,), jnp.bool_),
+        exit_code=jnp.zeros((p,), i32),
+        step_no=jnp.zeros((p,), i32),
+        n_processed=jnp.zeros((p,), i32),
+        n_delivered=jnp.zeros((p,), i32),
+        n_rejected=jnp.zeros((p,), i32),
+        n_badmsg=jnp.zeros((p,), i32),
+        n_deadletter=jnp.zeros((p,), i32),
+        n_mutes=jnp.zeros((p,), i32),
         type_state=type_state,
     )

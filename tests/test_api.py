@@ -70,7 +70,7 @@ def test_deadletter_counted():
     dead = a + 1 if a + 1 < 2 else a - 1
     rt.send(dead, A.bump, 1)
     rt.run(max_steps=10)
-    assert int(rt.state.n_deadletter) == 1
+    assert rt.counter("n_deadletter") == 1
 
 
 def test_strip_runtime_flags():
