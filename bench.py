@@ -38,6 +38,7 @@ def main():
                     default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
     ap.add_argument("--warmup", type=int, default=20)
     args = ap.parse_args()
+    args.warmup = max(1, args.warmup)   # the first step pays the jit
 
     import jax
     from ponyc_tpu import RuntimeOptions

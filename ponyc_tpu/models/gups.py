@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from .. import I32, Runtime, RuntimeOptions, actor, behaviour
 
 
 @actor
@@ -29,7 +29,11 @@ class TableCell:
 @actor
 class Updater:
     rng: I32
-    table_base: I32
+    # TableCell id layout (shard-major; see Cohort.slot_to_gid): cell slot s
+    # lives at gid (s % n_shards) * n_local + cell_start + s // n_shards.
+    cell_start: I32
+    n_shards: I32
+    n_local: I32
     table_size: I32
     done: I32
 
@@ -43,15 +47,12 @@ class Updater:
         x = x ^ (x << 13)
         x = x ^ ((x >> 17) & 0x7FFF)
         x = x ^ (x << 5)
-        idx = jabs(x) % st["table_size"]
-        self.send(st["table_base"] + idx, TableCell.update, x, when=n > 0)
+        slot = x % st["table_size"]     # jnp %: non-negative for divisor > 0
+        gid = ((slot % st["n_shards"]) * st["n_local"]
+               + st["cell_start"] + slot // st["n_shards"])
+        self.send(gid, TableCell.update, x, when=n > 0)
         self.send(self.actor_id, Updater.tick, n - 1, when=n > 1)
         return {**st, "rng": x, "done": st["done"] + (n > 0)}
-
-
-def jabs(x):
-    import jax.numpy as jnp
-    return jnp.where(x < 0, -x, x)
 
 
 def build(table_size: int = 4096, n_updaters: int = 64,
@@ -62,11 +63,14 @@ def build(table_size: int = 4096, n_updaters: int = 64,
     rt.declare(TableCell, table_size).declare(Updater, n_updaters)
     rt.start()
     cells = rt.spawn_many(TableCell, table_size)
+    cell_cohort = rt.program.by_type[TableCell]
     rng = np.random.default_rng(7)
     upd = rt.spawn_many(
         Updater, n_updaters,
         rng=rng.integers(1, 2**31 - 1, n_updaters),
-        table_base=np.full(n_updaters, cells.min()),
+        cell_start=cell_cohort.local_start,
+        n_shards=rt.program.shards,
+        n_local=rt.program.n_local,
         table_size=table_size)
     return rt, cells, upd
 

@@ -42,7 +42,8 @@ from jax import lax
 from ..api import Context
 from ..config import RuntimeOptions
 from ..ops import pack
-from ..ops.segment import compact_mask, counts_by_key, segment_ranks
+from ..ops.segment import (compact_mask, counts_by_key, segment_ranks,
+                           stable_sort_by)
 from ..program import Cohort, Program
 from .delivery import Entries, deliver
 from .state import RtState
@@ -205,7 +206,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     e = tgt.shape[0]
     valid = tgt >= 0
     dest = jnp.where(valid, tgt // n_local, shards).astype(jnp.int32)
-    perm = jnp.argsort(dest, stable=True)
+    perm = stable_sort_by(dest)
     dt = dest[perm]
     ok = dt < shards
     rank = segment_ranks(dt)
@@ -389,7 +390,9 @@ def build_step(program: Program, opts: RuntimeOptions):
                          | (res.spill_count > 0) | (rsp_count > 0))
         host_pending = (jnp.any(occ_after[fh:] > 0) if fh < nl
                         else jnp.bool_(False))
-        overflow = res.spill_overflow | rsp_over
+        # Sticky: once any step overflowed, every later aux reports it, so
+        # the host catches it whatever its fetch cadence (quiesce_interval).
+        overflow = st.spill_overflow[0] | res.spill_overflow | rsp_over
         if p > 1:
             device_pending = lax.psum(
                 local_pending.astype(jnp.int32), "actors") > 0
@@ -422,7 +425,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             rspill_tgt=new_rspill.tgt, rspill_sender=new_rspill.sender,
             rspill_words=new_rspill.words,
             rspill_count=vec(rsp_count),
-            spill_overflow=vec(st.spill_overflow[0] | overflow, jnp.bool_),
+            spill_overflow=vec(overflow, jnp.bool_),
             exit_flag=vec(exit_f, jnp.bool_), exit_code=vec(exit_c),
             step_no=vec(st.step_no[0] + 1),
             n_processed=vec(st.n_processed[0] + nproc_total),

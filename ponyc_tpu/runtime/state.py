@@ -57,7 +57,7 @@ class RtState:
     alive: jnp.ndarray        # [N] bool — slot occupied (≙ !PENDINGDESTROY)
     muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
     mute_ref: jnp.ndarray     # [N] int32 — global id of the muting
-    #                              receiver; -1 none; -2 remote (see engine)
+    #                              receiver (may be off-shard); -1 = none
 
     # Receiver-side overflow spill (local-row targets).
     dspill_tgt: jnp.ndarray    # [P*S] int32 local row, -1 = empty slot

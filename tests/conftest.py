@@ -13,3 +13,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin re-asserts itself over JAX_PLATFORMS at import time;
+# the config knob set after import is authoritative.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from ponyc_tpu import Runtime, RuntimeOptions, actor, behaviour, I32, Ref
-from ponyc_tpu.models import ring
+from ponyc_tpu.models import gups, ring
 
 
 MESH_OPTS = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
@@ -61,3 +61,28 @@ def test_fanout_across_shards_and_counters():
     assert st["total"].sum() == sum(10 * (i + 1) for i in range(4)) * 2 + 4
     assert rt.totals["processed"] == 12  # 4 go + 8 recv
     assert rt.totals["delivered"] == 12
+
+
+def test_gups_across_shards():
+    # Updates land on cells scattered over 4 shards; xor-conservation holds
+    # only if every update reached the cell its slot arithmetic names.
+    opts = RuntimeOptions(mailbox_cap=16, batch=2, max_sends=2, msg_words=1,
+                          mesh_shards=4, spill_cap=256)
+    rt = gups.run(table_size=64, n_updaters=8, updates_each=16, opts=opts)
+    st_u = rt.cohort_state(gups.Updater)
+    assert st_u["done"].sum() == 8 * 16
+    # Replay the xorshift stream host-side: xor of all cells must equal the
+    # xor of every value ever sent.
+    import numpy as np
+    rng0 = np.random.default_rng(7).integers(1, 2**31 - 1, 8).astype(np.int64)
+    expect = 0
+    for x in rng0:
+        for _ in range(16):
+            x = np.int32(x ^ (x << 13))
+            x = np.int32(x ^ ((x >> 17) & 0x7FFF))
+            x = np.int32(x ^ (x << 5))
+            expect ^= int(np.uint32(x))
+    got = 0
+    for v in rt.cohort_state(gups.TableCell)["value"]:
+        got ^= int(np.uint32(v))
+    assert got == expect
