@@ -1,0 +1,229 @@
+"""ctypes bindings for the native host runtime (libponyx_host.so).
+
+The native layer is the TPU framework's C++ counterpart of the
+reference's host-side runtime services (SURVEY.md §2.1): the pool
+allocator (mem/pool.c), the MPSC staging queue (actor/messageq.c) and
+the ASIO event loop (asio/asio.c + asio/epoll.c). Device-side execution
+(mailbox table, dispatch, routing) lives in XLA; this library covers the
+pieces that must stay on the host — OS events, timers, signals, sockets
+— exactly where the reference keeps its ASIO thread.
+
+The shared library builds on first import with g++ if missing (the
+toolchain is part of the environment; there is no wheel step).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "build", "libponyx_host.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    res = subprocess.run(["make", "-C", _DIR, "-s"],
+                         capture_output=True, text=True)
+    if res.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{res.stdout}\n{res.stderr}")
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if necessary) the native library, once per process."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        srcs = [os.path.join(_DIR, "src", f)
+                for f in os.listdir(os.path.join(_DIR, "src"))]
+        if (not os.path.exists(_SO)
+                or any(os.path.getmtime(s) > os.path.getmtime(_SO)
+                       for s in srcs)):
+            _build()
+        l = ctypes.CDLL(_SO)
+        c = ctypes
+        l.ponyx_pool_alloc.restype = c.c_void_p
+        l.ponyx_pool_alloc.argtypes = [c.c_size_t]
+        l.ponyx_pool_free.argtypes = [c.c_size_t, c.c_void_p]
+        l.ponyx_pool_allocated.restype = c.c_uint64
+        l.ponyx_pool_recycled.restype = c.c_uint64
+        l.ponyx_pool_index.restype = c.c_int
+        l.ponyx_pool_index.argtypes = [c.c_size_t]
+
+        l.ponyx_mpscq_create.restype = c.c_void_p
+        l.ponyx_mpscq_destroy.argtypes = [c.c_void_p]
+        l.ponyx_mpscq_push.argtypes = [c.c_void_p,
+                                       c.POINTER(c.c_int32), c.c_int32]
+        l.ponyx_mpscq_pop.restype = c.c_int32
+        l.ponyx_mpscq_pop.argtypes = [c.c_void_p,
+                                      c.POINTER(c.c_int32), c.c_int32]
+        l.ponyx_mpscq_count.restype = c.c_int64
+        l.ponyx_mpscq_count.argtypes = [c.c_void_p]
+
+        l.ponyx_asio_create.restype = c.c_void_p
+        l.ponyx_asio_destroy.argtypes = [c.c_void_p]
+        l.ponyx_asio_timer.restype = c.c_int32
+        l.ponyx_asio_timer.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
+                                       c.c_int32, c.c_int32, c.c_int32,
+                                       c.c_int32]
+        l.ponyx_asio_signal.restype = c.c_int32
+        l.ponyx_asio_signal.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                        c.c_int32, c.c_int32]
+        l.ponyx_asio_fd.restype = c.c_int32
+        l.ponyx_asio_fd.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                    c.c_int32, c.c_int32, c.c_int32,
+                                    c.c_int32]
+        l.ponyx_asio_unsubscribe.restype = c.c_int32
+        l.ponyx_asio_unsubscribe.argtypes = [c.c_void_p, c.c_int32]
+        l.ponyx_asio_drain.restype = c.c_int32
+        l.ponyx_asio_drain.argtypes = [c.c_void_p,
+                                       c.POINTER(c.c_int32), c.c_int32]
+        l.ponyx_asio_pending.restype = c.c_int64
+        l.ponyx_asio_pending.argtypes = [c.c_void_p]
+        l.ponyx_asio_noisy_add.argtypes = [c.c_void_p]
+        l.ponyx_asio_noisy_remove.argtypes = [c.c_void_p]
+        l.ponyx_asio_noisy_count.restype = c.c_int64
+        l.ponyx_asio_noisy_count.argtypes = [c.c_void_p]
+        _lib = l
+        return _lib
+
+
+def pool_stats() -> Tuple[int, int]:
+    """(live blocks, parked blocks) from the native pool allocator."""
+    l = lib()
+    return int(l.ponyx_pool_allocated()), int(l.ponyx_pool_recycled())
+
+
+class HostQueue:
+    """MPSC staging queue of int32-word messages (native-backed)."""
+
+    def __init__(self):
+        self._l = lib()
+        self._q = self._l.ponyx_mpscq_create()
+
+    def push(self, words) -> None:
+        arr = np.ascontiguousarray(words, np.int32)
+        self._l.ponyx_mpscq_push(
+            self._q, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            arr.size)
+
+    def pop(self, max_words: int = 64) -> Optional[np.ndarray]:
+        out = np.empty((max_words,), np.int32)
+        n = self._l.ponyx_mpscq_pop(
+            self._q, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            max_words)
+        if n == 0:
+            return None
+        if n < 0:
+            return self.pop(-n)
+        return out[:n].copy()
+
+    def __len__(self) -> int:
+        return int(self._l.ponyx_mpscq_count(self._q))
+
+    def close(self) -> None:
+        if self._q:
+            self._l.ponyx_mpscq_destroy(self._q)
+            self._q = None
+
+
+# Event kinds in drained records (see asio.cc header comment).
+TIMER, SIGNAL, FD_READ, FD_WRITE, FD_HUP = 1, 2, 3, 4, 5
+
+
+class AsioEvent:
+    """One drained event: (sub_id, owner, behaviour, kind, arg, flags)."""
+
+    __slots__ = ("sub_id", "owner", "behaviour", "kind", "arg", "flags")
+
+    def __init__(self, row):
+        (self.sub_id, self.owner, self.behaviour,
+         self.kind, self.arg, self.flags) = (int(x) for x in row)
+
+    def __repr__(self):
+        return (f"AsioEvent(sub={self.sub_id} owner={self.owner} "
+                f"beh={self.behaviour} kind={self.kind} arg={self.arg})")
+
+
+class AsioLoop:
+    """The native epoll event loop (one dedicated thread).
+
+    ≙ ponyint_asio_start / the backend dispatch thread
+    (asio/asio.c:47-56, asio/epoll.c:207-230). Owned by the bridge
+    package; applications use the stdlib actors (timers, net) instead.
+    """
+
+    def __init__(self):
+        self._l = lib()
+        self._h = self._l.ponyx_asio_create()
+
+    def timer(self, first_ns: int, interval_ns: int, owner: int,
+              behaviour: int, *, oneshot: bool = False,
+              noisy: bool = True) -> int:
+        r = self._l.ponyx_asio_timer(self._h, first_ns, interval_ns,
+                                     owner, behaviour, int(oneshot),
+                                     int(noisy))
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+        return r
+
+    def signal(self, signum: int, owner: int, behaviour: int,
+               *, noisy: bool = False) -> int:
+        r = self._l.ponyx_asio_signal(self._h, signum, owner, behaviour,
+                                      int(noisy))
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+        return r
+
+    def fd(self, fd: int, owner: int, behaviour: int, *,
+           read: bool = True, write: bool = False, oneshot: bool = False,
+           noisy: bool = True) -> int:
+        interest = (1 if read else 0) | (2 if write else 0)
+        r = self._l.ponyx_asio_fd(self._h, fd, interest, owner, behaviour,
+                                  int(oneshot), int(noisy))
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+        return r
+
+    def unsubscribe(self, sub_id: int) -> bool:
+        return bool(self._l.ponyx_asio_unsubscribe(self._h, sub_id))
+
+    def drain(self, max_events: int = 256) -> List[AsioEvent]:
+        out = np.empty((max_events, 6), np.int32)
+        n = self._l.ponyx_asio_drain(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            max_events)
+        return [AsioEvent(out[i]) for i in range(n)]
+
+    def pending(self) -> int:
+        return int(self._l.ponyx_asio_pending(self._h))
+
+    def noisy_add(self) -> None:
+        self._l.ponyx_asio_noisy_add(self._h)
+
+    def noisy_remove(self) -> None:
+        self._l.ponyx_asio_noisy_remove(self._h)
+
+    @property
+    def noisy(self) -> int:
+        return int(self._l.ponyx_asio_noisy_count(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._l.ponyx_asio_destroy(self._h)
+            self._h = None

@@ -254,14 +254,15 @@ class Runtime:
             else:
                 words[:, 1 + i] = col.astype(np.int32)
         tail = self.state.tail
-        occ = np.asarray(tail[targets] - self.state.head[targets])
+        t_at = np.asarray(tail[targets])
+        occ = t_at - np.asarray(self.state.head[targets])
         if (occ >= self.opts.mailbox_cap).any():
             full = targets[occ >= self.opts.mailbox_cap]
             raise RuntimeError(
                 f"bulk_send would overflow {len(full)} full mailbox(es) "
                 f"(first target {int(full[0])}); drain with run() first or "
                 "raise mailbox_cap")
-        slot = np.asarray(tail[targets]) % self.opts.mailbox_cap
+        slot = t_at % self.opts.mailbox_cap
         self.state = self._replace(
             buf=self.state.buf.at[targets, slot].set(jnp.asarray(words)),
             tail=tail.at[targets].add(1))

@@ -73,7 +73,6 @@ def test_gups_across_shards():
     assert st_u["done"].sum() == 8 * 16
     # Replay the xorshift stream host-side: xor of all cells must equal the
     # xor of every value ever sent.
-    import numpy as np
     rng0 = np.random.default_rng(7).integers(1, 2**31 - 1, 8).astype(np.int64)
     expect = 0
     for x in rng0:

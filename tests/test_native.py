@@ -1,0 +1,164 @@
+"""Native host-runtime layer tests (pool, MPSC queue, ASIO epoll loop).
+
+≙ the reference's runtime unit tests (test/libponyrt/mem/pool.cc and the
+asio paths exercised via stdlib socket/timer tests) — here driven through
+the ctypes bindings, no device involved.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import native
+
+
+def test_pool_class_index():
+    l = native.lib()
+    assert l.ponyx_pool_index(1) == 0
+    assert l.ponyx_pool_index(32) == 0
+    assert l.ponyx_pool_index(33) == 1
+    assert l.ponyx_pool_index(64) == 1
+    assert l.ponyx_pool_index(1 << 20) == 15
+
+
+def test_pool_alloc_recycles():
+    l = native.lib()
+    a = l.ponyx_pool_alloc(100)
+    assert a
+    l.ponyx_pool_free(100, a)
+    b = l.ponyx_pool_alloc(100)   # same class → same block back
+    assert b == a
+    l.ponyx_pool_free(100, b)
+
+
+def test_hostq_fifo_roundtrip():
+    q = native.HostQueue()
+    for i in range(100):
+        q.push([i, i * 2, i * 3])
+    assert len(q) == 100
+    for i in range(100):
+        m = q.pop()
+        assert m is not None and list(m) == [i, i * 2, i * 3]
+    assert q.pop() is None
+    q.close()
+
+
+def test_hostq_variable_width_and_regrow_pop():
+    q = native.HostQueue()
+    q.push(np.arange(80, dtype=np.int32))
+    m = q.pop(max_words=16)   # too small → internally retried with 80
+    assert m is not None and m.size == 80
+    q.close()
+
+
+def test_hostq_concurrent_producers():
+    q = native.HostQueue()
+    n_threads, per = 8, 500
+
+    def produce(t):
+        for i in range(per):
+            q.push([t, i])
+
+    ts = [threading.Thread(target=produce, args=(t,))
+          for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    seen = {t: [] for t in range(n_threads)}
+    while (m := q.pop()) is not None:
+        seen[int(m[0])].append(int(m[1]))
+    # MPSC guarantee: per-producer FIFO survives interleaving
+    for t in range(n_threads):
+        assert seen[t] == list(range(per))
+    q.close()
+
+
+def test_asio_timer_fires():
+    loop = native.AsioLoop()
+    loop.timer(2_000_000, 2_000_000, owner=7, behaviour=3)  # 2ms period
+    deadline = time.time() + 2.0
+    events = []
+    while time.time() < deadline and len(events) < 3:
+        events.extend(loop.drain())
+        time.sleep(0.005)
+    assert len(events) >= 3
+    ev = events[0]
+    assert (ev.owner, ev.behaviour, ev.kind) == (7, 3, native.TIMER)
+    assert ev.arg >= 1          # expiration count
+    assert loop.noisy >= 1      # periodic timer holds liveness
+    loop.close()
+
+
+def test_asio_oneshot_timer_unsubscribes_itself():
+    loop = native.AsioLoop()
+    loop.timer(1_000_000, 0, owner=1, behaviour=0, oneshot=True)
+    time.sleep(0.1)
+    evs = loop.drain()
+    assert len(evs) == 1 and evs[0].kind == native.TIMER
+    assert loop.noisy == 0      # oneshot released its noisy hold
+    time.sleep(0.05)
+    assert loop.drain() == []   # never fires again
+    loop.close()
+
+
+def test_asio_fd_readable_pipe():
+    loop = native.AsioLoop()
+    r, w = os.pipe()
+    os.set_blocking(r, False)
+    loop.fd(r, owner=42, behaviour=9)
+    os.write(w, b"x")
+    deadline = time.time() + 2.0
+    events = []
+    while time.time() < deadline and not events:
+        events = loop.drain()
+        time.sleep(0.005)
+    assert events and events[0].kind == native.FD_READ
+    assert events[0].arg == r and events[0].owner == 42
+    os.read(r, 1)               # level-triggered: clear readability
+    os.close(r)
+    os.close(w)
+    loop.close()
+
+
+def test_asio_signal_delivery():
+    loop = native.AsioLoop()
+    loop.signal(signal.SIGUSR1, owner=5, behaviour=2)
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.time() + 2.0
+    events = []
+    while time.time() < deadline and not events:
+        events = loop.drain()
+        time.sleep(0.005)
+    assert events and events[0].kind == native.SIGNAL
+    assert events[0].arg == signal.SIGUSR1 and events[0].owner == 5
+    loop.close()
+
+
+def test_asio_unsubscribe_stops_events():
+    loop = native.AsioLoop()
+    sid = loop.timer(1_000_000, 1_000_000, owner=1, behaviour=1)
+    time.sleep(0.05)
+    assert loop.unsubscribe(sid)
+    loop.drain(1024)
+    time.sleep(0.05)
+    assert loop.drain() == []
+    assert loop.noisy == 0
+    loop.close()
+
+
+def test_asio_noisy_manual_holds():
+    loop = native.AsioLoop()
+    assert loop.noisy == 0
+    loop.noisy_add()
+    loop.noisy_add()
+    assert loop.noisy == 2
+    loop.noisy_remove()
+    assert loop.noisy == 1
+    loop.noisy_remove()
+    assert loop.noisy == 0
+    loop.close()
