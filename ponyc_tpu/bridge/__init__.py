@@ -1,0 +1,121 @@
+"""Host↔runtime ASIO bridge: OS events become actor messages.
+
+≙ the reference's ASIO wiring end to end (SURVEY.md §3.4): the epoll
+backend thread turns fd/timer/signal readiness into `pony_asio_event_send`
+→ a message in the owning actor's mailbox (src/libponyrt/asio/event.c,
+asio/epoll.c:207-230). Here the native loop (ponyc_tpu/native) stages
+events on an MPSC queue and the Bridge — registered as a Runtime poller —
+drains them at step boundaries into ordinary actor sends.
+
+Subscribed behaviours use one uniform signature, mirroring Pony's
+``_event_notify(event, flags, arg)`` (packages/builtin/asio_event.pony)::
+
+    @behaviour
+    def on_event(self, st, kind: I32, arg: I32, flags: I32): ...
+
+kind: 1=timer 2=signal 3=fd-read 4=fd-write 5=fd-hup (native module
+constants); arg: expiry count / signum / fd.
+
+Liveness: while any noisy subscription exists the runtime will not
+terminate on quiescence (≙ asio.c:80-91 noisy_count and the scheduler's
+asio hooks at scheduler.c:448-471).
+"""
+
+from __future__ import annotations
+
+import signal as _signal
+import sys
+from typing import Dict, Optional
+
+from .. import native
+from ..api import BehaviourDef
+
+
+class Bridge:
+    """One native event loop bound to one Runtime (register via
+    ``rt.attach_bridge()``)."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.loop = native.AsioLoop()
+        self._subs: Dict[int, BehaviourDef] = {}
+        self._noisy_given = 0     # noisy holds mirrored into the runtime
+
+    # -- subscriptions (≙ pony_asio_event_create/subscribe) --
+    def _check(self, owner: int, bdef: BehaviourDef) -> None:
+        if not isinstance(bdef, BehaviourDef) or bdef.global_id is None:
+            raise TypeError("subscribe with a program-registered behaviour")
+        if len(bdef.arg_specs) != 3:
+            raise TypeError(
+                f"{bdef} must take (kind, arg, flags) — the uniform asio "
+                "event signature")
+
+    def timer(self, owner: int, bdef: BehaviourDef, interval_s: float,
+              *, first_s: Optional[float] = None, oneshot: bool = False,
+              noisy: bool = True) -> int:
+        self._check(owner, bdef)
+        first = interval_s if first_s is None else first_s
+        sid = self.loop.timer(max(1, int(first * 1e9)),
+                              max(1, int(interval_s * 1e9)),
+                              int(owner), bdef.global_id,
+                              oneshot=oneshot, noisy=noisy)
+        self._subs[sid] = bdef
+        return sid
+
+    def signal(self, owner: int, bdef: BehaviourDef, signum: int,
+               *, noisy: bool = False) -> int:
+        self._check(owner, bdef)
+        sid = self.loop.signal(int(signum), int(owner), bdef.global_id,
+                               noisy=noisy)
+        self._subs[sid] = bdef
+        return sid
+
+    def fd(self, owner: int, bdef: BehaviourDef, fd: int, *,
+           read: bool = True, write: bool = False, oneshot: bool = False,
+           noisy: bool = True) -> int:
+        self._check(owner, bdef)
+        sid = self.loop.fd(int(fd), int(owner), bdef.global_id,
+                           read=read, write=write, oneshot=oneshot,
+                           noisy=noisy)
+        self._subs[sid] = bdef
+        return sid
+
+    def stdin(self, owner: int, bdef: BehaviourDef, *,
+              noisy: bool = True) -> int:
+        """Readiness events for standard input (≙ lang/stdfd.c +
+        packages/builtin/std_stream.pony input wiring)."""
+        return self.fd(owner, bdef, sys.stdin.fileno(), noisy=noisy)
+
+    def sigterm_dump(self, owner: int, bdef: BehaviourDef) -> int:
+        """Convenience: SIGTERM → a diagnostic behaviour (≙ the fork's
+        SIGTERM live-actor dump, analysis.c:55, cycle.c:874-954)."""
+        return self.signal(owner, bdef, _signal.SIGTERM)
+
+    def unsubscribe(self, sub_id: int) -> bool:
+        self._subs.pop(sub_id, None)
+        return self.loop.unsubscribe(sub_id)
+
+    # -- poller protocol (called by Runtime.run at host boundaries) --
+    def poll(self, rt) -> int:
+        n = 0
+        for ev in self.loop.drain():
+            bdef = self._subs.get(ev.sub_id)
+            if bdef is None:      # unsubscribed with events still queued
+                continue
+            rt.send(ev.owner, bdef, ev.kind, ev.arg, ev.flags)
+            n += 1
+        # Mirror the loop's noisy count into the runtime's liveness hold.
+        want = self.loop.noisy + (1 if self.loop.pending() else 0)
+        while self._noisy_given < want:
+            rt.add_noisy()
+            self._noisy_given += 1
+        while self._noisy_given > want:
+            rt.remove_noisy()
+            self._noisy_given -= 1
+        return n
+
+    def close(self) -> None:
+        while self._noisy_given > 0:
+            self.rt.remove_noisy()
+            self._noisy_given -= 1
+        self.loop.close()

@@ -19,6 +19,7 @@ asio/asio.c) are drained at those same boundaries.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -274,10 +275,30 @@ class Runtime:
         w1 = 1 + self.opts.msg_words
         tgt = np.full((k,), -1, np.int32)
         words = np.zeros((k, w1), np.int32)
-        for i in range(min(k, len(self._inject_q))):
+        # Host-side flow control: at most one drain-batch per target per
+        # step, so a burst (e.g. timer events queued during a long XLA
+        # compile) can never outrun the receiver and trip the bounded
+        # device spill. Held-back messages keep their per-target FIFO
+        # order in the deque — the host queue is the unbounded tier the
+        # reference gets from pool-backed mailboxes (messageq.c).
+        taken: Dict[int, int] = {}
+        quota: Dict[int, int] = {}
+        held: List[Any] = []
+        i = 0
+        while i < k and self._inject_q:
             t, w = self._inject_q.popleft()
+            q = quota.get(t)
+            if q is None:
+                q = quota[t] = self.program.cohort_of(t).batch
+            c = taken.get(t, 0)
+            if c >= q:
+                held.append((t, w))
+                continue
+            taken[t] = c + 1
             tgt[i] = t
             words[i] = w
+            i += 1
+        self._inject_q.extendleft(reversed(held))
         return jnp.asarray(tgt), jnp.asarray(words)
 
     # ---- asio bridge hooks (≙ asio/asio.c noisy accounting) ----
@@ -291,6 +312,15 @@ class Runtime:
         """poller.poll(rt) is called at every host boundary; it may inject
         messages (timers/sockets/stdin — the bridge package uses this)."""
         self._bridge_pollers.append(poller)
+
+    def attach_bridge(self):
+        """Create (once) and register the ASIO bridge for this runtime
+        (≙ ponyint_asio_start, asio/asio.c:47-56)."""
+        if getattr(self, "bridge", None) is None:
+            from ..bridge import Bridge
+            self.bridge = Bridge(self)
+            self.register_poller(self.bridge)
+        return self.bridge
 
     # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
     def _drain_host(self) -> bool:
@@ -381,6 +411,10 @@ class Runtime:
                 idle_polls += 1
                 if self._noisy == 0 and idle_polls > 2:
                     break
+                # Waiting on external events (timers/fds): back off
+                # exponentially instead of hot-spinning device steps
+                # (≙ the fork's scaling_sleep, scheduler.c:918-935).
+                time.sleep(min(0.002, 2e-5 * (1 << min(idle_polls, 7))))
             else:
                 idle_polls = 0
             if max_steps is not None and steps_this_run >= max_steps:
