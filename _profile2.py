@@ -1,0 +1,113 @@
+"""Narrow down the 9ms dispatch: which part of run_cohort is slow?"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from ponyc_tpu import RuntimeOptions
+from ponyc_tpu.models import ubench
+from ponyc_tpu.runtime import engine
+
+N = 1 << 20
+CAP = 8
+
+
+def timeit(name, fn, *args, reps=20):
+    r = jax.jit(fn)
+    out = r(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = r(*args)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:44s} {dt:8.3f} ms")
+    return dt
+
+
+opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                      spill_cap=1024, inject_slots=8)
+rt, ids = ubench.build(N, opts)
+ubench.seed_all(rt, ids, hops=1 << 30)
+st = rt.state
+ch = rt.program.device_cohorts[0]
+print("platform:", jax.devices()[0].platform)
+
+disp = engine._cohort_dispatch(ch, opts, opts.noyield)
+idsj = jnp.arange(N, dtype=jnp.int32)
+
+# Precompute msgs/valids outside
+def gather_msgs(state):
+    k = jnp.arange(1, dtype=jnp.int32)
+    idx = (state.head[:, None] + k[None, :]) % CAP
+    msgs = jnp.take_along_axis(state.buf, idx[:, :, None], axis=1)
+    occ = state.tail - state.head
+    n_run = jnp.minimum(occ, 1)
+    valids = k[None, :] < n_run[:, None]
+    return msgs, valids
+
+msgs, valids = jax.jit(gather_msgs)(st)
+jax.block_until_ready(msgs)
+timeit("gather msgs+valids", gather_msgs, st)
+
+# build vfn manually (mirror _cohort_dispatch internals)
+from ponyc_tpu.ops import pack
+field_dtypes = {f: jnp.int32 for f in ch.atype.field_specs}
+branches = [engine._make_branch(b, 1, 1, field_dtypes)
+            for b in ch.behaviours]
+branches.append(engine._make_noop_branch(1, 1))
+nb = len(ch.behaviours)
+base = ch.behaviours[0].global_id
+
+
+def actor_fn(st_row, msg, valid, actor_id):
+    local = msg[0, 0] - base
+    in_range = (local >= 0) & (local < nb)
+    do = valid[0] & in_range
+    bid = jnp.where(do, local, nb)
+    st2, (stgt, swords), (ef, ec), yf = jax.lax.switch(
+        bid, branches, (st_row, msg[0, 1:], actor_id))
+    return st2, stgt, swords, ef, ec, do
+
+
+vfn = jax.vmap(actor_fn)
+
+
+def switch_only(ts, msgs, valids):
+    return vfn(ts, msgs, valids, idsj)
+
+ts = st.type_state[ch.atype.__name__]
+timeit("vmapped switch (no scan)", switch_only, ts, msgs, valids)
+
+
+def branch_direct(ts, msgs, valids):
+    # no switch at all: call the behaviour branch directly, vmapped
+    def one(st_row, msg, valid, actor_id):
+        return branches[0]((st_row, msg[0, 1:], actor_id))
+    return jax.vmap(one)(ts, msgs, valids, idsj)
+
+timeit("vmapped behaviour direct (no switch)", branch_direct, ts, msgs, valids)
+
+
+def full_cohort(state):
+    occ = state.tail - state.head
+    return disp(state.type_state[ch.atype.__name__], state.buf,
+                state.head, occ, state.alive, idsj)
+
+timeit("full run_cohort (scan+switch)", full_cohort, st)
+
+# scan with batch=1 vs no scan: isolate scan overhead
+def with_scan(ts, msgs, valids):
+    def body(carry, x):
+        st_row = carry
+        msg, valid = x
+        st2, stgt, swords, ef, ec, do = actor_fn(st_row, msg[None], valid[None], jnp.int32(0))
+        return st2, (stgt, swords)
+    def per_actor(st_row, msgs_row, valids_row):
+        return jax.lax.scan(body, st_row, (msgs_row, valids_row))
+    return jax.vmap(per_actor)(ts, msgs, valids)
+
+timeit("vmapped scan(batch=1) of switch", with_scan, ts, msgs, valids)

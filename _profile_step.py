@@ -1,0 +1,104 @@
+"""Component-level timing of the ubench tick on the real TPU."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+N = 1 << 20
+CAP = 8
+W1 = 2     # 1+msg_words
+E = N      # out entries for batch=1 max_sends=1
+
+
+def timeit(name, fn, *args, reps=20):
+    r = jax.jit(fn)
+    out = r(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = r(*args)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:40s} {dt:8.3f} ms")
+    return dt
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    tgt = jax.random.permutation(key, jnp.arange(N, dtype=jnp.int32))
+    words = jnp.zeros((E, W1), jnp.int32)
+    buf = jnp.zeros((N, CAP, W1), jnp.int32)
+    head = jnp.zeros((N,), jnp.int32)
+    tail = jnp.ones((N,), jnp.int32)
+    vals = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, N, jnp.int32)
+
+    print("platform:", jax.devices()[0].platform)
+    timeit("argsort 1M i32 (stable)",
+           lambda k: jnp.argsort(k, stable=True), vals)
+    timeit("sort 1M i32", lambda k: jnp.sort(k), vals)
+    timeit("sort_key_val 1M (k,v)",
+           lambda k: jax.lax.sort_key_val(k, jnp.arange(N, dtype=jnp.int32)), vals)
+    timeit("gather 1M rows from [1M,2]",
+           lambda w, p: w[p], words, tgt)
+    timeit("scatter set [1M] rows into [1M,8,2]",
+           lambda b, t, w: b.at[t, jnp.zeros((E,), jnp.int32)].set(
+               w, mode="drop"), buf, tgt, words)
+    timeit("scatter-add counts 1M into 1M",
+           lambda t: jnp.zeros((N,), jnp.int32).at[t].add(1, mode="drop"),
+           tgt)
+    timeit("assoc-scan max 1M", lambda v: jax.lax.associative_scan(
+        jnp.maximum, v), vals)
+    timeit("cumsum 1M", lambda v: jnp.cumsum(v), vals)
+    timeit("take_along_axis [1M,8,2] b=1",
+           lambda b, h: jnp.take_along_axis(
+               b, (h[:, None] % CAP)[:, :, None], axis=1), buf, head)
+
+    # dispatch-only: the vmapped scan/switch part of the engine
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ubench
+    from ponyc_tpu.runtime import engine
+    from ponyc_tpu.runtime.delivery import deliver, Entries
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                          spill_cap=1024, inject_slots=8)
+    rt, ids = ubench.build(N, opts)
+    ubench.seed_all(rt, ids, hops=1 << 30)
+    st = rt.state
+
+    ch = rt.program.device_cohorts[0]
+    disp = engine._cohort_dispatch(ch, opts, opts.noyield)
+    idsj = jnp.arange(N, dtype=jnp.int32)
+
+    def dispatch_only(state):
+        occ = state.tail - state.head
+        return disp(state.type_state[ch.atype.__name__], state.buf,
+                    state.head, occ, state.alive, idsj)
+
+    timeit("dispatch only (drain+switch+outbox)", dispatch_only, st)
+
+    entries = Entries(tgt=tgt, sender=idsj, words=words)
+
+    def deliver_only(state):
+        return deliver(state.buf, state.head, state.tail, state.alive,
+                       entries, n_local=N, mailbox_cap=CAP,
+                       spill_cap=1024, overload_occ=6, shard_base=0)
+
+    timeit("deliver only (sort+rank+scatter)", deliver_only, st)
+
+    step = engine.jit_step(rt.program, rt.opts, None)
+    inj = rt._empty_inject
+    s2, aux = step(st, *inj)
+    jax.block_until_ready(aux)
+    t0 = time.time()
+    s = s2
+    for _ in range(20):
+        s, aux = step(s, *inj)
+    jax.block_until_ready(aux)
+    print(f"{'full step':40s} {(time.time()-t0)/20*1e3:8.3f} ms")
+
+
+main()

@@ -1,0 +1,154 @@
+"""World serialisation: checkpoint and resume a running actor world.
+
+≙ the reference's serialisation subsystem (src/libponyrt/gc/serialise.c:
+`pony_serialise`/`pony_deserialise` flatten an object graph to an
+offset-encoded buffer using per-type trace hooks; `packages/serialise`
+is the stdlib surface). The reference has no built-in checkpoint/resume
+(SURVEY.md §5) — serialisation is its building block, and here it is
+promoted to a first-class feature: the *entire world* (device SoA state,
+mailboxes in flight, host-actor state, allocator freelists, counters) is
+one snapshot, because the TPU runtime's whole point is that world state
+is a single pytree.
+
+Type identity is structural: a fingerprint over cohort layout, field
+specs and behaviour signatures (≙ the descriptor table registered at
+pony_start, start.c:286-292, which makes serialised ids stable between
+runs of the same binary). Restoring into a runtime whose fingerprint
+differs is an error — the same guarantee the reference gets from "same
+binary".
+
+Snapshots are written at host boundaries (between jitted steps), where
+device state is quiescent-consistent — no in-flight step, exactly like
+serialising between behaviours in Pony.
+
+Format: one .npz (numpy archive) holding every array plus a JSON header;
+written atomically via temp-file rename.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+class FingerprintMismatch(RuntimeError):
+    """Snapshot was taken by a structurally different program."""
+
+
+def fingerprint(program) -> str:
+    """Structural hash of the program layout (≙ the per-type descriptor
+    table identity; serialise.c relies on same-binary type ids)."""
+    h = hashlib.sha256()
+    for cohort in program.cohorts:
+        atype = cohort.atype
+        h.update(atype.__name__.encode())
+        h.update(str(cohort.capacity).encode())
+        h.update(b"H" if cohort.host else b"D")
+        for fname, spec in sorted(atype.field_specs.items()):
+            h.update(fname.encode())
+            h.update(spec.__name__.encode())
+        for b in cohort.behaviours:
+            h.update(b.name.encode())
+            h.update(str(b.global_id).encode())
+            for spec in b.arg_specs:
+                h.update(spec.__name__.encode())
+    return h.hexdigest()[:32]
+
+
+def _opts_dict(opts) -> Dict[str, Any]:
+    return dataclasses.asdict(opts)
+
+
+def save(rt, path: str) -> None:
+    """Snapshot the full world to `path` (.npz). Call between runs/steps
+    only (any queued-but-uninjected host sends are included)."""
+    if rt.state is None:
+        raise RuntimeError("runtime not started")
+    arrays: Dict[str, np.ndarray] = {}
+    flat, treedef = jax.tree_util.tree_flatten(rt.state)
+    for i, leaf in enumerate(flat):
+        arrays[f"state_{i}"] = np.asarray(jax.device_get(leaf))
+    inject = list(rt._inject_q)
+    arrays["inject_tgt"] = np.asarray([t for t, _ in inject], np.int32)
+    if inject:
+        arrays["inject_words"] = np.stack([w for _, w in inject])
+    else:
+        arrays["inject_words"] = np.zeros(
+            (0, 1 + rt.opts.msg_words), np.int32)
+
+    header = {
+        "format": FORMAT_VERSION,
+        "fingerprint": fingerprint(rt.program),
+        "opts": _opts_dict(rt.opts),
+        "n_state_leaves": len(flat),
+        "free": rt._free,
+        "host_state": {str(k): v for k, v in rt._host_state.items()},
+        "totals": dict(rt.totals),
+        "last_counters": rt._last_counters,
+        "steps_run": rt.steps_run,
+        "exit_code": rt._exit_code,
+        "noisy": rt._noisy,
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(buf, header=np.frombuffer(
+        json.dumps(header).encode(), np.uint8), **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def restore(rt, path: str) -> None:
+    """Load a snapshot into a started runtime with the same program
+    structure (actor classes, capacities, options geometry)."""
+    if rt.state is None:
+        raise RuntimeError("call start() before restore()")
+    with np.load(path, allow_pickle=False) as z:
+        header = json.loads(bytes(z["header"]).decode())
+        if header["format"] != FORMAT_VERSION:
+            raise FingerprintMismatch(
+                f"snapshot format {header['format']} != {FORMAT_VERSION}")
+        fp = fingerprint(rt.program)
+        if header["fingerprint"] != fp:
+            raise FingerprintMismatch(
+                "snapshot was taken by a structurally different program "
+                f"({header['fingerprint']} != {fp})")
+        flat, treedef = jax.tree_util.tree_flatten(rt.state)
+        if header["n_state_leaves"] != len(flat):
+            raise FingerprintMismatch("state leaf count mismatch")
+        new_flat = []
+        for i, leaf in enumerate(flat):
+            arr = z[f"state_{i}"]
+            if arr.shape != leaf.shape:
+                raise FingerprintMismatch(
+                    f"state leaf {i} shape {arr.shape} != {leaf.shape} "
+                    "(options geometry must match the snapshot)")
+            new_flat.append(jnp.asarray(arr, leaf.dtype))
+        state = jax.tree_util.tree_unflatten(treedef, new_flat)
+        if rt.mesh is not None:
+            from .parallel.mesh import shard_state
+            state = shard_state(state, rt.mesh)
+        rt.state = state
+        rt._inject_q.clear()
+        tgts = z["inject_tgt"]
+        words = z["inject_words"]
+        for i in range(len(tgts)):
+            rt._inject_q.append((int(tgts[i]), words[i]))
+    rt._free = {k: [int(x) for x in v] for k, v in header["free"].items()}
+    rt._host_state = {int(k): v for k, v in header["host_state"].items()}
+    rt.totals.clear()
+    rt.totals.update(header["totals"])
+    rt._last_counters = dict(header["last_counters"])
+    rt.steps_run = int(header["steps_run"])
+    rt._exit_code = int(header["exit_code"])
+    rt._noisy = int(header["noisy"])

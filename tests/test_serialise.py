@@ -1,0 +1,113 @@
+"""Checkpoint/resume tests (≙ the serialise subsystem, gc/serialise.c,
+promoted to whole-world snapshots; reference parity check = the
+round-trip guarantees packages/serialise tests assert)."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor, behaviour,
+                       serialise)
+from ponyc_tpu.models import ring
+
+
+def _opts(**kw):
+    base = dict(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                spill_cap=64, inject_slots=8)
+    base.update(kw)
+    return RuntimeOptions(**base)
+
+
+def _build_ring(n, opts):
+    rt = Runtime(opts).declare(ring.RingNode, n).start()
+    ids = rt.spawn_many(ring.RingNode, n)
+    rt.set_fields(ring.RingNode, ids, next_ref=np.roll(ids, -1))
+    return rt, ids
+
+
+def test_snapshot_mid_flight_resume_matches(tmp_path):
+    # Run A: 300 hops straight through.
+    rt_a, ids_a = _build_ring(8, _opts())
+    rt_a.send(int(ids_a[0]), ring.RingNode.token, 300)
+    rt_a.run()
+    want = rt_a.cohort_state(ring.RingNode)["passes"]
+
+    # Run B: same program, checkpointed mid-flight, resumed elsewhere.
+    rt_b, ids_b = _build_ring(8, _opts())
+    rt_b.send(int(ids_b[0]), ring.RingNode.token, 300)
+    rt_b.run(max_steps=57)                       # part-way: token in flight
+    serialise.save(rt_b, str(tmp_path / "w.npz"))
+
+    rt_c, _ = _build_ring(8, _opts())
+    serialise.restore(rt_c, str(tmp_path / "w.npz"))
+    assert rt_c.steps_run == rt_b.steps_run
+    rt_c.run()
+    got = rt_c.cohort_state(ring.RingNode)["passes"]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_snapshot_preserves_queued_host_sends(tmp_path):
+    rt, ids = _build_ring(4, _opts())
+    rt.send(int(ids[0]), ring.RingNode.token, 7)   # still in _inject_q
+    serialise.save(rt, str(tmp_path / "w.npz"))
+
+    rt2, _ = _build_ring(4, _opts())
+    serialise.restore(rt2, str(tmp_path / "w.npz"))
+    assert len(rt2._inject_q) == 1
+    rt2.run()
+    assert rt2.cohort_state(ring.RingNode)["passes"].sum() == 7
+
+
+def test_fingerprint_rejects_different_program(tmp_path):
+    rt, _ = _build_ring(4, _opts())
+    serialise.save(rt, str(tmp_path / "w.npz"))
+
+    @actor
+    class Other:
+        x: I32
+
+        @behaviour
+        def go(self, st, v: I32):
+            return st
+
+    rt2 = Runtime(_opts()).declare(Other, 4).start()
+    with pytest.raises(serialise.FingerprintMismatch):
+        serialise.restore(rt2, str(tmp_path / "w.npz"))
+
+
+def test_geometry_mismatch_rejected(tmp_path):
+    rt, _ = _build_ring(4, _opts())
+    serialise.save(rt, str(tmp_path / "w.npz"))
+    rt2, _ = _build_ring(4, _opts(mailbox_cap=16))
+    with pytest.raises(serialise.FingerprintMismatch):
+        serialise.restore(rt2, str(tmp_path / "w.npz"))
+
+
+def test_host_actor_state_round_trips(tmp_path):
+    @actor
+    class Keeper:
+        HOST = True
+        total: I32
+
+        @behaviour
+        def add(self, st, v: I32):
+            st["total"] = st["total"] + v
+            return st
+
+    def build():
+        return Runtime(_opts(msg_words=2, batch=4)).declare(
+            Keeper, 1).start()
+
+    rt = build()
+    kid = rt.spawn(Keeper)
+    rt.send(kid, Keeper.add, 5)
+    rt.run(max_steps=50)
+    assert rt.state_of(kid)["total"] == 5
+    serialise.save(rt, str(tmp_path / "w.npz"))
+
+    rt2 = build()
+    rt2.spawn(Keeper)
+    serialise.restore(rt2, str(tmp_path / "w.npz"))
+    assert rt2.state_of(kid)["total"] == 5
+    rt2.send(kid, Keeper.add, 3)
+    rt2.run(max_steps=50)
+    assert rt2.state_of(kid)["total"] == 8
