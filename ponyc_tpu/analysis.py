@@ -1,0 +1,159 @@
+"""Runtime analysis/telemetry — ≙ the fork's `--ponyanalysis` subsystem
+(src/libponyrt/analysis/analysis.{c,h}; DIVERGENCE.md "--ponyanalysis").
+
+The reference streams per-event records (mute/overload/pressure/run/gc/
+msg-send, analysis.h:16-31) from every scheduler onto a dedicated
+analysis thread that writes CSV to /tmp/pony.ponyrt_analytics, with
+level 1 adding a SIGTERM live-world dump. The TPU re-design keeps the
+same three levels and the same dedicated-writer-thread shape, but the
+unit of record is a *step window*, not a message: per-event host
+callbacks would serialise the device, while window aggregates
+(counters + occupancy/mute/overload reductions computed in the jitted
+step when analysis >= 1) cost nothing observable.
+
+  level 0 — off (default; the aux telemetry lanes compile to constants)
+  level 1 — summary on run() end + SIGTERM/SIGUSR1 live-world dump
+            (≙ sigintHandler analysis.c:55 + cycle.c:874-954 dump_views)
+  level 2 — level 1 + one CSV row per quiesce window to
+            RuntimeOptions.analysis_path via a writer thread
+            (≙ analysis.c:41-167 thread + CSV format)
+
+Wire-up: ``analysis.attach(rt)`` (Runtime.run calls the hook
+automatically when opts.analysis >= 1 and nothing is attached yet).
+"""
+
+from __future__ import annotations
+
+import queue
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+CSV_COLUMNS = [
+    "time_ms", "step", "processed", "delivered", "rejected", "badmsg",
+    "deadletter", "mutes", "occ_sum", "occ_max", "muted_now",
+    "overloaded_now", "host_processed", "inject_queue",
+]
+
+
+class Analysis:
+    """Per-runtime telemetry collector + writer thread (level 2)."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.level = rt.opts.analysis
+        self.t0 = time.time()
+        self._rows: "queue.Queue" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._prev = {}
+        self._prev_sig = {}
+        if self.level >= 2:
+            self._writer = threading.Thread(target=self._write_loop,
+                                            daemon=True)
+            self._writer.start()
+
+    # -- window hook (called by Runtime.run after each aux fetch) --
+    def window(self, aux) -> None:
+        if self.level < 2:
+            return
+        row = [
+            round((time.time() - self.t0) * 1e3, 3),
+            self.rt.steps_run,
+            self._delta("processed", self.rt.totals["processed"]),
+            self._delta("delivered", self.rt.totals["delivered"]),
+            self._delta("rejected", self.rt.counter("n_rejected")),
+            self._delta("badmsg", self.rt.counter("n_badmsg")),
+            self._delta("deadletter", self.rt.counter("n_deadletter")),
+            self._delta("mutes", self.rt.counter("n_mutes")),
+            int(aux.occ_sum), int(aux.occ_max),
+            int(aux.n_muted_now), int(aux.n_overloaded_now),
+            self._delta("host_processed",
+                        self.rt.totals.get("host_processed", 0)),
+            len(self.rt._inject_q),
+        ]
+        self._rows.put(row)
+
+    def _delta(self, key, cur) -> int:
+        prev = self._prev.get(key, 0)
+        self._prev[key] = cur
+        return int(cur - prev)
+
+    def _write_loop(self) -> None:
+        path = self.rt.opts.analysis_path
+        with open(path, "w") as f:
+            f.write(",".join(CSV_COLUMNS) + "\n")
+            while not (self._stop.is_set() and self._rows.empty()):
+                try:
+                    row = self._rows.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                f.write(",".join(str(x) for x in row) + "\n")
+                f.flush()
+
+    # -- live-world dump (level >= 1; SIGTERM/SIGUSR1 and run() end) --
+    def dump(self, out=None) -> str:
+        rt = self.rt
+        lines = ["=== ponyc_tpu analysis dump ==="]
+        lines.append(f"steps_run={rt.steps_run} "
+                     f"uptime_ms={round((time.time()-self.t0)*1e3, 1)}")
+        for name in ("n_processed", "n_delivered", "n_rejected",
+                     "n_badmsg", "n_deadletter", "n_mutes"):
+            lines.append(f"{name}={rt.counter(name)}")
+        lines.append(f"host_processed={rt.totals.get('host_processed', 0)} "
+                     f"inject_queue={len(rt._inject_q)}")
+        if rt.state is not None:
+            occ = np.asarray(rt.state.tail) - np.asarray(rt.state.head)
+            alive = np.asarray(rt.state.alive)
+            muted = np.asarray(rt.state.muted)
+            lines.append(f"actors_alive={int(alive.sum())} "
+                         f"muted={int(muted.sum())} "
+                         f"queued_msgs={int(occ.sum())} "
+                         f"deepest_queue={int(occ.max())}")
+            # Per-cohort queue depth summary (≙ per-actor tag rows in the
+            # reference's dump; cohorts are the TPU grouping).
+            for cohort in rt.program.cohorts:
+                cols = np.asarray(cohort.slot_to_gid(
+                    np.arange(cohort.capacity)), np.int64)
+                co = occ[cols]
+                lines.append(
+                    f"  cohort {cohort.atype.__name__}: "
+                    f"cap={cohort.capacity} queued={int(co.sum())} "
+                    f"max={int(co.max()) if co.size else 0} "
+                    f"muted={int(muted[cols].sum())}")
+        text = "\n".join(lines)
+        print(text, file=out or sys.stderr)
+        return text
+
+    def install_signal_dump(self, signums=(signal.SIGTERM,
+                                           signal.SIGUSR1)) -> None:
+        """Install dump-on-signal handlers (main thread only; ≙ the
+        reference installing its SIGTERM handler when analysis > 0)."""
+        for s in signums:
+            try:
+                signal.signal(s, lambda *_: self.dump())
+            except ValueError:   # not the main thread: skip
+                return
+
+    def summary(self) -> None:
+        if self.level >= 1:
+            self.dump()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._writer is not None:
+            self._writer.join(timeout=2.0)
+            self._writer = None
+
+
+def attach(rt) -> Analysis:
+    """Create and register the Analysis hook on a runtime."""
+    a = Analysis(rt)
+    rt._analysis = a
+    if a.level >= 1:
+        a.install_signal_dump()
+    return a

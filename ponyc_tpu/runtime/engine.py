@@ -63,6 +63,13 @@ class StepAux(NamedTuple):
     # (cumulative = state counters; the host accumulates mod-2^32 deltas,
     # so fetches may be arbitrarily far apart as long as fewer than 2^31
     # events occur between two fetches.)
+    # Telemetry aggregates (≙ --ponyanalysis, analysis.c): traced as real
+    # reductions only when opts.analysis >= 1, else constant zeros that
+    # XLA folds away — opt-in observability at zero steady-state cost.
+    occ_sum: jnp.ndarray         # int32 — total queued messages
+    occ_max: jnp.ndarray         # int32 — deepest mailbox
+    n_muted_now: jnp.ndarray     # int32 — actors currently muted
+    n_overloaded_now: jnp.ndarray  # int32 — occupancy > overload threshold
 
 
 def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes):
@@ -386,6 +393,14 @@ def build_step(program: Program, opts: RuntimeOptions):
         mute_ref2 = jnp.where(newly, new_ref, mute_ref)
 
         occ_after = res.tail - new_head
+        if opts.analysis >= 1:
+            occ_sum = jnp.sum(occ_after)
+            occ_max = jnp.max(occ_after)
+            n_muted_now = jnp.sum(muted2.astype(jnp.int32))
+            n_over_now = jnp.sum(
+                (occ_after > opts.overload_occ).astype(jnp.int32))
+        else:
+            occ_sum = occ_max = n_muted_now = n_over_now = jnp.int32(0)
         local_pending = (jnp.any(occ_after[:fh] > 0)
                          | (res.spill_count > 0) | (rsp_count > 0))
         host_pending = (jnp.any(occ_after[fh:] > 0) if fh < nl
@@ -405,6 +420,11 @@ def build_step(program: Program, opts: RuntimeOptions):
             nproc_all = lax.psum(st.n_processed[0] + nproc_total, "actors")
             ndel_all = lax.psum(st.n_delivered[0] + res.n_delivered,
                                 "actors")
+            if opts.analysis >= 1:
+                occ_sum = lax.psum(occ_sum, "actors")
+                occ_max = lax.pmax(occ_max, "actors")
+                n_muted_now = lax.psum(n_muted_now, "actors")
+                n_over_now = lax.psum(n_over_now, "actors")
         else:
             device_pending = local_pending
             exit_any = exit_f
@@ -444,6 +464,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             spill_overflow=overflow_any,
             n_processed=nproc_all,
             n_delivered=ndel_all,
+            occ_sum=occ_sum, occ_max=occ_max,
+            n_muted_now=n_muted_now, n_overloaded_now=n_over_now,
         )
         return st2, aux
 

@@ -371,6 +371,10 @@ class Runtime:
     def run(self, max_steps: Optional[int] = None) -> int:
         if self.state is None:
             raise RuntimeError("call start() first")
+        if self.opts.analysis >= 1 and getattr(self, "_analysis",
+                                               None) is None:
+            from .. import analysis as _analysis_mod
+            _analysis_mod.attach(self)
         self._exit_requested = False
         max_steps = max_steps or self.opts.max_steps
         qi = max(1, self.opts.quiesce_interval)
@@ -391,6 +395,8 @@ class Runtime:
                 last = self._last_counters.get(key, 0)
                 self.totals[key] += (cur - last) & 0xFFFFFFFF
                 self._last_counters[key] = cur
+            if getattr(self, "_analysis", None) is not None:
+                self._analysis.window(a)
             if bool(a.spill_overflow):
                 raise SpillOverflowError(
                     f"spill overflow at step {self.steps_run}")
@@ -419,6 +425,22 @@ class Runtime:
                 idle_polls = 0
             if max_steps is not None and steps_this_run >= max_steps:
                 break
+        return self._exit_code
+
+    def stop(self) -> int:
+        """Tear down auxiliaries (≙ pony_stop, start.c:332-351): emit the
+        analysis summary, stop the writer thread, close the bridge."""
+        a = getattr(self, "_analysis", None)
+        if a is not None:
+            a.summary()
+            a.close()
+            self._analysis = None
+        b = getattr(self, "bridge", None)
+        if b is not None:
+            b.close()
+            self.bridge = None
+            self._bridge_pollers = [p for p in self._bridge_pollers
+                                    if p is not b]
         return self._exit_code
 
     # ---- introspection (≙ ponyint_actor_num_messages, actor.c:666; and
