@@ -119,6 +119,10 @@ class ActorTypeMeta(type):
         cls.PRIORITY = ns.get("PRIORITY", 0)     # ≙ fork's priority hint
         cls.HOST = ns.get("HOST", False)         # ≙ use_main_thread: runs on host
         cls.TAG = ns.get("TAG", 0)               # ≙ fork's analysis tag
+        # Spawn budget (≙ pony_create from behaviour code, actor.c:688):
+        # {TargetType_or_name: max ctx.spawn() sites per dispatch}. Spawning
+        # is opt-in because reservations cost free-slot compaction per step.
+        cls.SPAWNS = ns.get("SPAWNS", {})
         return cls
 
     @property
@@ -151,15 +155,25 @@ class Context:
     """
 
     __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
-                 "yield_flag", "_spawns")
+                 "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
+                 "spawn_claims", "destroy_called")
 
-    def __init__(self, actor_id, msg_words: int):
+    def __init__(self, actor_id, msg_words: int, spawn_resv=None):
         self.actor_id = actor_id          # traced i32 scalar (global id)
         self.msg_words = msg_words
         self.sends: List[Tuple[Any, Any, Any]] = []   # (target, words, when)
         self.exit_flag = jnp.bool_(False)
         self.exit_code = jnp.int32(0)
         self.yield_flag = jnp.bool_(False)
+        self.destroy_flag = jnp.bool_(False)
+        self.spawn_fail = jnp.bool_(False)
+        self.destroy_called = False      # trace-time: did destroy() run?
+        # {target type name: [n_sites] i32 reserved global ids} for this
+        # dispatch; None entries = -1 (no free slot was available).
+        self._spawn_resv = spawn_resv or {}
+        # {target type name: [claimed refs so far]} (engine canonicalises).
+        self.spawn_claims: Dict[str, List[Any]] = {
+            t: [] for t in self._spawn_resv}
 
     # -- messaging (≙ pony_sendv, actor.c:773-834) --
     def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
@@ -176,6 +190,55 @@ class Context:
                            jnp.asarray(when, jnp.bool_)))
 
     # -- lifecycle --
+    def spawn(self, ctor: BehaviourDef, *args, when=True):
+        """Create an actor of the constructor's type and send it `ctor` as
+        its first message (≙ pony_create, actor.c:688-734 — in Pony
+        ``create`` *is* an async behaviour, so construction here is exactly
+        "claim a slot, deliver the constructor message").
+
+        Returns the new actor's ref (traced i32), usable immediately in
+        this behaviour's sends/state. The spawner's class must declare
+        ``SPAWNS = {TargetType: n_sites}``; slots come from the *same
+        shard* as the spawner (≙ pony_create allocating on the creating
+        scheduler's thread). If no free slot was available the ref is -1,
+        the sticky `spawn_fail` flag raises host-side, and the masked
+        constructor send drops harmlessly.
+        """
+        if not isinstance(ctor, BehaviourDef):
+            raise TypeError("spawn() takes a constructor behaviour "
+                            "(e.g. Worker.init)")
+        tname = ctor.actor_type.__name__
+        resv = self._spawn_resv.get(tname)
+        if resv is None:
+            raise RuntimeError(
+                f"{tname} is not in this actor type's SPAWNS declaration; "
+                f"add SPAWNS = {{{tname}: n}} to the spawning class")
+        used = len(self.spawn_claims[tname])
+        if used >= resv.shape[0]:
+            raise RuntimeError(
+                f"more than SPAWNS[{tname}]={resv.shape[0]} ctx.spawn() "
+                "calls in one behaviour dispatch; raise the declared budget")
+        ref = resv[used]
+        w = jnp.asarray(when, jnp.bool_)
+        ok = w & (ref >= 0)
+        self.spawn_claims[tname].append(jnp.where(ok, ref, jnp.int32(-1)))
+        self.spawn_fail = self.spawn_fail | (w & (ref < 0))
+        self.send(ref, ctor, *args, when=ok)
+        return jnp.where(ok, ref, jnp.int32(-1))
+
+    def destroy(self, when=True):
+        """Mark *this* actor for destruction at the end of the step: slot
+        freed, queued messages discarded, later sends dead-letter.
+
+        The reference never destroys explicitly — ORCA/cycle GC collects
+        (gc/cycle.c); this framework has that too (runtime.gc()). destroy()
+        is the cheap opt-out for protocols that know their own lifetime.
+        Refs held elsewhere dangle (and the slot may be reused by a later
+        spawn) — the documented divergence from ORCA's safety.
+        """
+        self.destroy_called = True
+        self.destroy_flag = self.destroy_flag | jnp.asarray(when, jnp.bool_)
+
     def exit(self, code=0, when=True):
         """Request program termination (≙ pony_exitcode + quiescent stop)."""
         w = jnp.asarray(when, jnp.bool_)

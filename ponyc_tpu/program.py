@@ -53,6 +53,10 @@ class Cohort:
         self.max_sends = getattr(atype, "MAX_SENDS", None) or opts.max_sends
         self.behaviours = list(atype.behaviour_defs)
         self.n_local_total = 0      # rows per shard over all cohorts (set later)
+        # Resolved by Program.finalize():
+        self.spawns: Dict[str, int] = {}     # target type name → sites/dispatch
+        self.spawn_offsets: Dict[str, int] = {}  # target name → offset into
+        #   the target cohort's compacted free-row list (static partition)
 
     def slot_to_gid(self, slot):
         """Cohort slot → global actor id (vectorised, numpy-friendly)."""
@@ -142,8 +146,56 @@ class Program:
                 bdef.local_id = local
                 self.behaviour_table.append(bdef)
                 gid += 1
+        self._resolve_spawns()
         self.frozen = True
         return self
+
+    def _resolve_spawns(self) -> None:
+        """Resolve SPAWNS declarations and statically partition each target
+        cohort's free-slot list among its spawner cohorts.
+
+        ≙ pony_create's allocation (actor.c:688) done ahead of time: each
+        (spawner, target) pair owns a contiguous window of the target's
+        compacted free rows, sized worst-case (rows × batch × sites), so
+        concurrent vmapped spawns can never collide. The partition is the
+        TPU-static price: a spawner can exhaust *its window* while another
+        window still has slots. Reservations unused at the end of a step
+        simply remain free.
+        """
+        by_name = {c.atype.__name__: c for c in self.cohorts}
+        offsets: Dict[str, int] = {n: 0 for n in by_name}
+        for cohort in self.cohorts:
+            raw = getattr(cohort.atype, "SPAWNS", {}) or {}
+            for key, sites in raw.items():
+                tname = key if isinstance(key, str) else key.__name__
+                target = by_name.get(tname)
+                if target is None:
+                    raise TypeError(
+                        f"{cohort.atype.__name__}.SPAWNS names {tname!r}, "
+                        "which is not declared in this Program")
+                if target.host or cohort.host:
+                    raise TypeError(
+                        "device-side spawn between host cohorts is not "
+                        "supported; spawn host actors from the host API")
+                if sites < 1:
+                    continue
+                cohort.spawns[tname] = int(sites)
+                cohort.spawn_offsets[tname] = offsets[tname]
+                offsets[tname] += (cohort.local_capacity * cohort.batch
+                                   * int(sites))
+
+    @property
+    def has_device_spawns(self) -> bool:
+        return any(c.spawns for c in self.cohorts)
+
+    @property
+    def spawn_target_names(self):
+        out = []
+        for c in self.cohorts:
+            for t in c.spawns:
+                if t not in out:
+                    out.append(t)
+        return out
 
     @property
     def device_cohorts(self) -> List[Cohort]:
@@ -161,6 +213,12 @@ class Program:
             if c.host:
                 return c.local_start
         return self.n_local
+
+    def by_type_name(self, name: str) -> Cohort:
+        for c in self.cohorts:
+            if c.atype.__name__ == name:
+                return c
+        raise KeyError(name)
 
     def cohort_of(self, actor_id: int) -> Cohort:
         if not 0 <= actor_id < self.total:

@@ -58,6 +58,7 @@ class StepAux(NamedTuple):
     exit_flag: jnp.ndarray       # bool — some behaviour called ctx.exit
     exit_code: jnp.ndarray       # int32
     spill_overflow: jnp.ndarray  # bool — fatal: a spill buffer exceeded
+    spawn_fail: jnp.ndarray      # bool — fatal: ctx.spawn found no slot
     n_processed: jnp.ndarray     # int32 — *cumulative* behaviours run
     n_delivered: jnp.ndarray     # int32 — *cumulative* deliveries
     # (cumulative = state counters; the host accumulates mod-2^32 deltas,
@@ -70,17 +71,31 @@ class StepAux(NamedTuple):
     occ_max: jnp.ndarray         # int32 — deepest mailbox
     n_muted_now: jnp.ndarray     # int32 — actors currently muted
     n_overloaded_now: jnp.ndarray  # int32 — occupancy > overload threshold
+    # Cumulative mesh-wide counters (zeros unless analysis >= 1) so the
+    # CSV window writer needs no extra device fetches.
+    n_rejected: jnp.ndarray      # int32
+    n_badmsg: jnp.ndarray        # int32
+    n_deadletter: jnp.ndarray    # int32
+    n_mutes: jnp.ndarray         # int32
 
 
-def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes):
-    """Wrap one behaviour into a switch branch with canonical outputs."""
+def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
+                 spawn_sites, effects):
+    """Wrap one behaviour into a switch branch with canonical outputs.
+
+    spawn_sites: ordered (target_name, n_sites) static budget — every
+    branch of a cohort's switch emits claims in this exact layout.
+    effects: trace-time mutable record of which effects any behaviour of
+    the cohort actually used (lets the engine skip dead scatters)."""
     w1 = 1 + msg_words
 
     def branch(operand):
-        st, payload, actor_id = operand
-        ctx = Context(actor_id, msg_words)
+        st, payload, actor_id, resv = operand
+        resv_dict = {t: r for (t, _), r in zip(spawn_sites, resv)}
+        ctx = Context(actor_id, msg_words, spawn_resv=resv_dict)
         args = pack.unpack_args(bdef.arg_specs, payload)
         st2 = bdef.fn(ctx, dict(st), *args)
+        effects["destroy"] = effects["destroy"] or ctx.destroy_called
         if st2 is None:
             raise TypeError(
                 f"behaviour {bdef} must return the (possibly updated) state "
@@ -105,22 +120,32 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes):
         tgt_arr = jnp.stack(tgts) if tgts else jnp.zeros((0,), jnp.int32)
         words_arr = (jnp.stack(words) if words
                      else jnp.zeros((0, w1), jnp.int32))
+        claims = []
+        for tname, n in spawn_sites:
+            got = ctx.spawn_claims.get(tname, [])
+            got = got + [jnp.int32(-1)] * (n - len(got))
+            claims.append(jnp.stack(got) if got
+                          else jnp.zeros((0,), jnp.int32))
         return (st2, (tgt_arr, words_arr),
-                (ctx.exit_flag, ctx.exit_code), ctx.yield_flag)
+                (ctx.exit_flag, ctx.exit_code), ctx.yield_flag,
+                tuple(claims), ctx.spawn_fail, ctx.destroy_flag)
 
     return branch
 
 
-def _make_noop_branch(msg_words: int, max_sends: int):
+def _make_noop_branch(msg_words: int, max_sends: int, spawn_sites):
     w1 = 1 + msg_words
 
     def branch(operand):
-        st, _payload, _actor_id = operand
+        st, _payload, _actor_id, _resv = operand
         return (dict(st),
                 (jnp.full((max_sends,), -1, jnp.int32),
                  jnp.zeros((max_sends, w1), jnp.int32)),
                 (jnp.bool_(False), jnp.int32(0)),
-                jnp.bool_(False))
+                jnp.bool_(False),
+                tuple(jnp.full((n,), -1, jnp.int32)
+                      for _, n in spawn_sites),
+                jnp.bool_(False), jnp.bool_(False))
 
     return branch
 
@@ -138,50 +163,60 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
     for fname, spec in cohort.atype.field_specs.items():
         field_dtypes[fname] = (jnp.float32 if spec is pack.F32
                                else jnp.int32)
-    branches = [_make_branch(b, msg_words, ms, field_dtypes)
+    spawn_sites = tuple(sorted(cohort.spawns.items()))
+    effects = {"destroy": False}
+    branches = [_make_branch(b, msg_words, ms, field_dtypes, spawn_sites,
+                             effects)
                 for b in cohort.behaviours]
-    branches.append(_make_noop_branch(msg_words, ms))
+    branches.append(_make_noop_branch(msg_words, ms, spawn_sites))
     nb = len(cohort.behaviours)
     base = cohort.behaviours[0].global_id if nb else 0
 
-    def actor_fn(st_row, msgs, valids, actor_id):
-        # msgs: [batch, 1+W]; valids: [batch] bool.
+    def actor_fn(st_row, msgs, valids, actor_id, resv):
+        # msgs: [batch, 1+W]; valids: [batch] bool;
+        # resv: {target: [batch, sites]} reserved refs per dispatch slot.
         def scan_body(carry, x):
-            st, stopped, ef, ec, nproc, nbad = carry
-            msg, valid = x
+            st, stopped, ef, ec, sfail, dstr, nproc, nbad = carry
+            msg, valid, resv_k = x
             local = msg[0] - base
             in_range = (local >= 0) & (local < nb)
             do = valid & ~stopped
             bid = jnp.where(do & in_range, local, nb)
-            st2, (stgt, swords), (bef, bec), yf = lax.switch(
-                bid, branches, (st, msg[1:], actor_id))
+            (st2, (stgt, swords), (bef, bec), yf, claims, bsf,
+             bdstr) = lax.switch(bid, branches, (st, msg[1:], actor_id,
+                                                 resv_k))
             new_ef = ef | bef
             new_ec = jnp.where(bef & ~ef, bec, ec)
             stopped2 = stopped if noyield else (stopped | yf)
-            return ((st2, stopped2, new_ef, new_ec,
+            return ((st2, stopped2, new_ef, new_ec, sfail | bsf,
+                     dstr | bdstr,
                      nproc + (do & in_range).astype(jnp.int32),
                      nbad + (do & ~in_range).astype(jnp.int32)),
-                    (stgt, swords, do))
+                    (stgt, swords, do, claims))
 
         carry0 = (st_row, jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
-                  jnp.int32(0), jnp.int32(0))
-        (stf, _, ef, ec, nproc, nbad), (stgt, swords, consumed) = lax.scan(
-            scan_body, carry0, (msgs, valids))
+                  jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
+                  jnp.int32(0))
+        resv_xs = tuple(resv[t] for t, _ in spawn_sites)
+        ((stf, _, ef, ec, sfail, dstr, nproc, nbad),
+         (stgt, swords, consumed, claims)) = lax.scan(
+            scan_body, carry0, (msgs, valids, resv_xs))
         n_consumed = jnp.sum(consumed.astype(jnp.int32))
-        return stf, (stgt, swords), ef, ec, nproc, nbad, n_consumed
+        return (stf, (stgt, swords), ef, ec, sfail, dstr, nproc, nbad,
+                n_consumed, claims)
 
     vfn = jax.vmap(actor_fn)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
-                   runnable_rows, ids):
+                   runnable_rows, ids, resv):
         n_run = jnp.where(runnable_rows,
                           jnp.minimum(occ_rows, batch), 0)
         k = jnp.arange(batch, dtype=jnp.int32)
         idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
         msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
         valids = k[None, :] < n_run[:, None]
-        stf, (stgt, swords), ef, ec, nproc, nbad, n_consumed = vfn(
-            type_state_rows, msgs, valids, ids)
+        (stf, (stgt, swords), ef, ec, sfail, dstr, nproc, nbad, n_consumed,
+         claims) = vfn(type_state_rows, msgs, valids, ids, resv)
         # Flatten the outbox: (actor, slot, send) order — exactly a
         # sender's causal emission order.
         e = cohort.local_capacity * batch * ms
@@ -191,8 +226,12 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                       words=swords.reshape(e, -1))
         any_exit = jnp.any(ef)
         code = ec[jnp.argmax(ef)]
+        # claims: tuple aligned with spawn_sites, each [rows, batch, sites].
+        flat_claims = {t: c.reshape(-1)
+                       for (t, _), c in zip(spawn_sites, claims)}
         return (stf, out, head_rows + n_consumed, any_exit, code,
-                jnp.sum(nproc), jnp.sum(nbad))
+                jnp.sum(nproc), jnp.sum(nbad), flat_claims,
+                jnp.any(sfail), dstr if effects["destroy"] else None)
 
     return run_cohort
 
@@ -310,25 +349,77 @@ def build_step(program: Program, opts: RuntimeOptions):
         muted = st.muted & ~release
         mute_ref = jnp.where(release, -1, st.mute_ref)
 
+        # --- 1b. spawn reservations (≙ pony_create's slot allocation,
+        # actor.c:688-734, done ahead of dispatch): per spawn-target
+        # cohort, compact this shard's free rows (dead, drained, no stale
+        # spill) and hand each spawner cohort its statically-partitioned
+        # window, reshaped to per-(actor, batch-slot, site) refs.
+        free_rows: Dict[str, jnp.ndarray] = {}
+        if program.spawn_target_names and p > 1:
+            # A message parked in *another shard's* route-spill may still
+            # be addressed to a locally dead row; reclaiming that row would
+            # deliver the stale message to the newborn. Make every shard's
+            # rspill targets globally visible (one psum over the mesh) —
+            # the cross-shard twin of the dspill_pending guard below.
+            rhit = jnp.zeros((p * nl,), jnp.int32).at[
+                jnp.maximum(st.rspill_tgt, 0)].max(
+                (st.rspill_tgt >= 0).astype(jnp.int32), mode="drop")
+            rhit = lax.psum(rhit, "actors")
+            rspill_hit = lax.dynamic_slice(rhit, (base,), (nl,)) > 0
+        else:
+            rspill_hit = jnp.zeros((nl,), jnp.bool_)
+        for tname in program.spawn_target_names:
+            tc = program.by_type_name(tname)
+            s0, s1 = tc.local_start, tc.local_stop
+            free_ok = (~st.alive[s0:s1] & (occ0[s0:s1] == 0)
+                       & (dspill_pending[s0:s1] == 0)
+                       & ~rspill_hit[s0:s1])
+            perm, vfree, _ = compact_mask(free_ok, tc.local_capacity)
+            free_rows[tname] = jnp.where(vfree, s0 + perm.astype(jnp.int32),
+                                         jnp.int32(-1))
+
+        def cohort_resv(ch):
+            resv = {}
+            for tname, sites in sorted(ch.spawns.items()):
+                need = ch.local_capacity * ch.batch * sites
+                off = ch.spawn_offsets[tname]
+                rows = jnp.take(free_rows[tname],
+                                off + jnp.arange(need, dtype=jnp.int32),
+                                mode="fill", fill_value=-1)
+                refs = jnp.where(rows >= 0, base + rows, jnp.int32(-1))
+                resv[tname] = refs.reshape(ch.local_capacity, ch.batch,
+                                           sites)
+            return resv
+
         # --- 2. drain + dispatch per cohort (≙ actor run loop).
         runnable = st.alive & ~muted
         new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
         head_segments: List[jnp.ndarray] = []
         out_entries: List[Entries] = []
+        claim_lists: Dict[str, List[jnp.ndarray]] = {
+            t: [] for t in program.spawn_target_names}
+        destroy_rows: List[Tuple[int, jnp.ndarray]] = []  # (s0, [rows] bool)
         exit_f = st.exit_flag[0]
         exit_c = st.exit_code[0]
+        spawn_fail = st.spawn_fail[0]
         nproc_total = jnp.int32(0)
         nbad_total = jnp.int32(0)
         for run_cohort, ch in dispatchers:
             s0, s1 = ch.local_start, ch.local_stop
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
-            stf, out, new_head_rows, ef, ec, nproc, nbad = run_cohort(
+            (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, sfail,
+             dstr) = run_cohort(
                 st.type_state[ch.atype.__name__],
                 st.buf[s0:s1], st.head[s0:s1], occ0[s0:s1],
-                runnable[s0:s1], ids)
+                runnable[s0:s1], ids, cohort_resv(ch))
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
             out_entries.append(out)
+            for t, cl in claims.items():
+                claim_lists[t].append(cl)
+            if ch.spawns:
+                spawn_fail = spawn_fail | sfail
+            destroy_rows.append((s0, dstr))
             exit_c = jnp.where(ef & ~exit_f, ec, exit_c)
             exit_f = exit_f | ef
             nproc_total = nproc_total + nproc
@@ -337,6 +428,31 @@ def build_step(program: Program, opts: RuntimeOptions):
             head_segments.append(st.head[fh:nl])
         new_head = (jnp.concatenate(head_segments) if head_segments
                     else st.head)
+
+        # --- 2b. apply spawn claims (before delivery, so constructor
+        # messages and same-step sends to the newborn land): claimed rows
+        # become alive with a fresh empty mailbox and zeroed state fields
+        # (the constructor behaviour initialises them — Pony's `create` is
+        # itself the first message).
+        alive = st.alive
+        tail0 = st.tail
+        n_spawned = jnp.int32(0)
+        for tname, clist in claim_lists.items():
+            if not clist:
+                continue
+            refs = jnp.concatenate(clist)
+            rows = jnp.where(refs >= 0, refs - base, nl)  # row nl → dropped
+            alive = alive.at[rows].set(True, mode="drop")
+            new_head = new_head.at[rows].set(0, mode="drop")
+            tail0 = tail0.at[rows].set(0, mode="drop")
+            n_spawned = n_spawned + jnp.sum((refs >= 0).astype(jnp.int32))
+            tc = program.by_type_name(tname)
+            cols = jnp.where(refs >= 0, rows - tc.local_start,
+                             tc.local_capacity)
+            ts = dict(new_type_state[tname])
+            for fname in ts:
+                ts[fname] = ts[fname].at[cols].set(0, mode="drop")
+            new_type_state[tname] = ts
 
         # --- 3. route (mesh) or pass through (single chip).
         rspill_e = Entries(st.rspill_tgt, st.rspill_sender, st.rspill_words)
@@ -355,7 +471,7 @@ def build_step(program: Program, opts: RuntimeOptions):
              route_ref) = _route(
                 out_cat, shards=p, n_local=nl, bucket=bucket,
                 rspill_cap=s_cap, overload_occ=opts.overload_occ,
-                head=new_head, tail=st.tail, shard_base=base)
+                head=new_head, tail=tail0, shard_base=base)
             incoming = incoming._replace(
                 tgt=jnp.where(incoming.tgt >= 0, incoming.tgt - base, -1))
         else:
@@ -381,26 +497,51 @@ def build_step(program: Program, opts: RuntimeOptions):
                                    incoming.words]),
         )
 
-        res = deliver(st.buf, new_head, st.tail, st.alive, all_e,
+        res = deliver(st.buf, new_head, tail0, alive, all_e,
                       n_local=nl, mailbox_cap=c, spill_cap=s_cap,
                       overload_occ=opts.overload_occ, shard_base=base)
 
+        # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
+        # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
+        # step; its remaining queue is discarded (head := tail), flags
+        # clear, and the row becomes reclaimable by a later spawn.
+        new_tail = res.tail
+        n_destroyed = jnp.int32(0)
+        for s0, dstr in destroy_rows:
+            if dstr is None:
+                continue
+            rows = jnp.where(dstr, s0 + jnp.arange(dstr.shape[0],
+                                                   dtype=jnp.int32), nl)
+            alive = alive.at[rows].set(False, mode="drop")
+            new_head = new_head.at[rows].set(
+                jnp.take(new_tail, jnp.minimum(rows, nl - 1)), mode="drop")
+            muted = muted.at[rows].set(False, mode="drop")
+            mute_ref = mute_ref.at[rows].set(-1, mode="drop")
+            n_destroyed = n_destroyed + jnp.sum(dstr.astype(jnp.int32))
+
         # --- 5. mute bookkeeping (≙ ponyint_mute_actor, actor.c:1171-1207).
-        newly = res.newly_muted | route_muted
+        newly = (res.newly_muted | route_muted) & alive
         new_ref = jnp.maximum(res.new_mute_ref, route_ref)
         became_muted = newly & ~muted
         muted2 = muted | newly
         mute_ref2 = jnp.where(newly, new_ref, mute_ref)
 
-        occ_after = res.tail - new_head
+        occ_after = new_tail - new_head
+        nrej_new = st.n_rejected[0] + res.n_rejected
+        nbad_new = st.n_badmsg[0] + nbad_total
+        ndl_new = st.n_deadletter[0] + res.n_deadletter
+        nmut_new = st.n_mutes[0] + jnp.sum(became_muted.astype(jnp.int32))
         if opts.analysis >= 1:
             occ_sum = jnp.sum(occ_after)
             occ_max = jnp.max(occ_after)
             n_muted_now = jnp.sum(muted2.astype(jnp.int32))
             n_over_now = jnp.sum(
                 (occ_after > opts.overload_occ).astype(jnp.int32))
+            nrej_all, nbad_all, ndl_all, nmut_all = (
+                nrej_new, nbad_new, ndl_new, nmut_new)
         else:
             occ_sum = occ_max = n_muted_now = n_over_now = jnp.int32(0)
+            nrej_all = nbad_all = ndl_all = nmut_all = jnp.int32(0)
         local_pending = (jnp.any(occ_after[:fh] > 0)
                          | (res.spill_count > 0) | (rsp_count > 0))
         host_pending = (jnp.any(occ_after[fh:] > 0) if fh < nl
@@ -409,6 +550,8 @@ def build_step(program: Program, opts: RuntimeOptions):
         # the host catches it whatever its fetch cadence (quiesce_interval).
         overflow = st.spill_overflow[0] | res.spill_overflow | rsp_over
         if p > 1:
+            spawn_fail_any = lax.psum(
+                spawn_fail.astype(jnp.int32), "actors") > 0
             device_pending = lax.psum(
                 local_pending.astype(jnp.int32), "actors") > 0
             exit_any = lax.psum(exit_f.astype(jnp.int32), "actors") > 0
@@ -425,7 +568,12 @@ def build_step(program: Program, opts: RuntimeOptions):
                 occ_max = lax.pmax(occ_max, "actors")
                 n_muted_now = lax.psum(n_muted_now, "actors")
                 n_over_now = lax.psum(n_over_now, "actors")
+                nrej_all = lax.psum(nrej_all, "actors")
+                nbad_all = lax.psum(nbad_all, "actors")
+                ndl_all = lax.psum(ndl_all, "actors")
+                nmut_all = lax.psum(nmut_all, "actors")
         else:
+            spawn_fail_any = spawn_fail
             device_pending = local_pending
             exit_any = exit_f
             exit_code_all = exit_c
@@ -437,8 +585,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             return jnp.asarray(x, dtype).reshape(1)
 
         st2 = RtState(
-            buf=res.buf, head=new_head, tail=res.tail,
-            alive=st.alive, muted=muted2, mute_ref=mute_ref2,
+            buf=res.buf, head=new_head, tail=new_tail,
+            alive=alive, muted=muted2, mute_ref=mute_ref2,
             dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
             dspill_words=res.spill.words,
             dspill_count=vec(res.spill_count),
@@ -450,11 +598,13 @@ def build_step(program: Program, opts: RuntimeOptions):
             step_no=vec(st.step_no[0] + 1),
             n_processed=vec(st.n_processed[0] + nproc_total),
             n_delivered=vec(st.n_delivered[0] + res.n_delivered),
-            n_rejected=vec(st.n_rejected[0] + res.n_rejected),
-            n_badmsg=vec(st.n_badmsg[0] + nbad_total),
-            n_deadletter=vec(st.n_deadletter[0] + res.n_deadletter),
-            n_mutes=vec(st.n_mutes[0]
-                        + jnp.sum(became_muted.astype(jnp.int32))),
+            n_rejected=vec(nrej_new),
+            n_badmsg=vec(nbad_new),
+            n_deadletter=vec(ndl_new),
+            n_mutes=vec(nmut_new),
+            n_spawned=vec(st.n_spawned[0] + n_spawned),
+            n_destroyed=vec(st.n_destroyed[0] + n_destroyed),
+            spawn_fail=vec(spawn_fail, jnp.bool_),
             type_state=new_type_state,
         )
         aux = StepAux(
@@ -462,10 +612,13 @@ def build_step(program: Program, opts: RuntimeOptions):
             host_pending=host_pending,
             exit_flag=exit_any, exit_code=exit_code_all,
             spill_overflow=overflow_any,
+            spawn_fail=spawn_fail_any,
             n_processed=nproc_all,
             n_delivered=ndel_all,
             occ_sum=occ_sum, occ_max=occ_max,
             n_muted_now=n_muted_now, n_overloaded_now=n_over_now,
+            n_rejected=nrej_all, n_badmsg=nbad_all,
+            n_deadletter=ndl_all, n_mutes=nmut_all,
         )
         return st2, aux
 

@@ -39,6 +39,12 @@ class SpillOverflowError(RuntimeError):
     spill_cap, or let backpressure mute faster (lower overload_threshold)."""
 
 
+class SpawnCapacityError(RuntimeError):
+    """A device-side ctx.spawn() wanted a slot but its cohort window had
+    none free — raise the target cohort's declared capacity (or let GC /
+    destroy() return slots faster)."""
+
+
 class HostContext:
     """Effect collector for host-resident behaviours (≙ running an actor on
     the main-thread scheduler, scheduler.c:1030-1035)."""
@@ -165,6 +171,16 @@ class Runtime:
         if unknown:
             raise TypeError(f"{atype.__name__} has no fields {unknown}")
         free = self._free[atype.__name__]
+        if not cohort.host and (self.program.has_device_spawns
+                                or self.steps_run):
+            # Device-side spawn/destroy/GC may have claimed or freed slots
+            # behind the host freelist's back — rebuild from device truth
+            # (highest slot first, matching the initial freelist order).
+            alive = np.asarray(jax.device_get(self.state.alive))
+            all_slots = np.arange(cohort.capacity - 1, -1, -1)
+            gids = np.asarray(cohort.slot_to_gid(all_slots))
+            free = [int(s) for s, g in zip(all_slots, gids) if not alive[g]]
+            self._free[atype.__name__] = free
         if len(free) < count:
             raise RuntimeError(
                 f"cohort {atype.__name__} capacity exhausted "
@@ -400,6 +416,10 @@ class Runtime:
             if bool(a.spill_overflow):
                 raise SpillOverflowError(
                     f"spill overflow at step {self.steps_run}")
+            if bool(a.spawn_fail):
+                raise SpawnCapacityError(
+                    f"device spawn found no free slot by step "
+                    f"{self.steps_run}")
             if bool(a.exit_flag):
                 self._exit_code = int(a.exit_code)
                 break

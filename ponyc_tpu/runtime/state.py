@@ -86,6 +86,9 @@ class RtState:
     n_badmsg: jnp.ndarray     # [P] int32 — wrong-type behaviour ids dropped
     n_deadletter: jnp.ndarray  # [P] int32 — sends to dead/unspawned slots
     n_mutes: jnp.ndarray      # [P] int32 — mute transitions
+    n_spawned: jnp.ndarray    # [P] int32 — device-side ctx.spawn() claims
+    n_destroyed: jnp.ndarray  # [P] int32 — ctx.destroy() completions
+    spawn_fail: jnp.ndarray   # [P] bool — sticky: a wanted spawn had no slot
 
     # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
     # (leading axis shard-major; see Cohort.slot_to_col).
@@ -136,5 +139,8 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_badmsg=jnp.zeros((p,), i32),
         n_deadletter=jnp.zeros((p,), i32),
         n_mutes=jnp.zeros((p,), i32),
+        n_spawned=jnp.zeros((p,), i32),
+        n_destroyed=jnp.zeros((p,), i32),
+        spawn_fail=jnp.zeros((p,), jnp.bool_),
         type_state=type_state,
     )

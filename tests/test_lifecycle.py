@@ -1,0 +1,172 @@
+"""Device-side actor lifecycle: ctx.spawn / ctx.destroy.
+
+≙ pony_create from behaviour code (src/libponyrt/actor/actor.c:688-734 —
+in Pony every actor is created by another actor at runtime) and actor
+destruction (ponyint_actor_destroy, actor.c:570-664). The reference has no
+isolated unit tests for these (SURVEY.md §4 — exercised via stdlib tests);
+we add the missing layer here.
+"""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor, behaviour)
+from ponyc_tpu.runtime.runtime import SpawnCapacityError
+
+
+@actor
+class Worker:
+    boss: Ref
+    value: I32
+
+    @behaviour
+    def init(self, st, boss: Ref, value: I32):
+        # Constructor behaviour (≙ Pony's `new create(...)` — itself the
+        # actor's first message). Report back so the parent learns our ref.
+        self.send(boss, Boss.started, self.actor_id)
+        return {**st, "boss": boss, "value": value}
+
+    @behaviour
+    def stop(self, st):
+        self.destroy()
+        return st
+
+
+@actor
+class Boss:
+    n_started: I32
+    last_child: Ref
+
+    SPAWNS = {"Worker": 2}
+    MAX_SENDS = 2
+
+    @behaviour
+    def go(self, st, count: I32):
+        a = self.spawn(Worker.init, self.actor_id, 11, when=count >= 1)
+        self.spawn(Worker.init, self.actor_id, 22, when=count >= 2)
+        return {**st, "last_child": a}
+
+    @behaviour
+    def started(self, st, child: Ref):
+        return {**st, "n_started": st["n_started"] + 1}
+
+
+def _mk(worker_cap=8, boss_cap=2, **kw):
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=2, msg_words=2,
+                          spill_cap=64, inject_slots=8, **kw)
+    rt = Runtime(opts).declare(Worker, worker_cap).declare(Boss, boss_cap)
+    rt.start()
+    return rt
+
+
+def test_spawn_creates_and_constructs():
+    rt = _mk()
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 2)
+    rt.run(max_steps=20)
+    assert rt.counter("n_spawned") == 2
+    assert rt.state_of(boss)["n_started"] == 2
+    ws = rt.cohort_state(Worker)
+    alive = np.asarray(rt.state.alive)
+    assert alive.sum() == 3  # boss + two workers
+    assert sorted(v for v in ws["value"] if v) == [11, 22]
+    # Parent held the first child's ref at spawn time (same dispatch).
+    assert rt.state_of(boss)["last_child"] >= 0
+    assert rt.state_of(rt.state_of(boss)["last_child"])["value"] == 11
+
+
+def test_masked_spawn_does_not_claim():
+    rt = _mk()
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 1)   # second site masked out
+    rt.run(max_steps=20)
+    assert rt.counter("n_spawned") == 1
+    assert rt.state_of(boss)["n_started"] == 1
+
+
+def test_destroy_frees_and_deadletters():
+    rt = _mk()
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 2)
+    rt.run(max_steps=20)
+    child = rt.state_of(boss)["last_child"]
+    rt.send(child, Worker.stop)
+    rt.run(max_steps=20)
+    assert rt.counter("n_destroyed") == 1
+    assert not bool(np.asarray(rt.state.alive)[child])
+    # Sends to the destroyed actor dead-letter (≙ impossible in Pony —
+    # ORCA keeps referenced actors alive; here it's a counted drop).
+    before = rt.counter("n_deadletter")
+    rt.send(child, Worker.stop)
+    rt.run(max_steps=20)
+    assert rt.counter("n_deadletter") == before + 1
+
+
+def test_destroyed_slot_is_reused():
+    rt = _mk(worker_cap=2, boss_cap=1)
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 2)     # fills both worker slots
+    rt.run(max_steps=20)
+    assert rt.counter("n_spawned") == 2
+    child = rt.state_of(boss)["last_child"]
+    rt.send(child, Worker.stop)   # free one slot
+    rt.run(max_steps=20)
+    rt.send(boss, Boss.go, 1)     # must reuse the freed slot
+    rt.run(max_steps=20)
+    assert rt.counter("n_spawned") == 3
+    assert rt.state_of(boss)["n_started"] == 3
+    assert np.asarray(rt.state.alive).sum() == 3
+
+
+def test_spawn_capacity_exhaustion_raises():
+    rt = _mk(worker_cap=1, boss_cap=1)
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 2)     # wants 2 slots, only 1 exists
+    with pytest.raises(SpawnCapacityError):
+        rt.run(max_steps=20)
+
+
+def test_host_spawn_sees_device_claims():
+    rt = _mk(worker_cap=3, boss_cap=1)
+    boss = rt.spawn(Boss)
+    rt.send(boss, Boss.go, 2)
+    rt.run(max_steps=20)
+    # Host-side spawn must not hand out the two device-claimed slots.
+    w = rt.spawn(Worker, value=99)
+    assert rt.state_of(w)["value"] == 99
+    alive = np.asarray(rt.state.alive)
+    assert alive.sum() == 4
+    with pytest.raises(RuntimeError):
+        rt.spawn(Worker)          # cohort genuinely full now
+
+
+def test_host_spawn_after_device_destroy_reclaims():
+    rt = _mk(worker_cap=2, boss_cap=1)
+    ws = rt.spawn_many(Worker, 2)
+    for w in ws:
+        rt.send(int(w), Worker.stop)
+    rt.run(max_steps=20)
+    assert rt.counter("n_destroyed") == 2
+    # The host freelist re-syncs from device truth: both slots are free
+    # again even though host-side spawns had popped them.
+    w = rt.spawn(Worker, value=7)
+    assert rt.state_of(w)["value"] == 7
+
+
+def test_spawn_on_mesh_stays_shard_local():
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=2, msg_words=2,
+                          spill_cap=64, inject_slots=8, mesh_shards=4)
+    rt = Runtime(opts).declare(Worker, 16).declare(Boss, 4)
+    rt.start()
+    bosses = rt.spawn_many(Boss, 4)
+    for b in bosses:
+        rt.send(int(b), Boss.go, 2)
+    rt.run(max_steps=30)
+    assert rt.counter("n_spawned") == 8
+    # Every child lives on its parent's shard (≙ pony_create allocating on
+    # the creating scheduler's own thread).
+    nl = rt.program.n_local
+    for b in bosses:
+        child = rt.state_of(int(b))["last_child"]
+        assert child // nl == int(b) // nl
+        assert rt.state_of(int(b))["n_started"] == 2
