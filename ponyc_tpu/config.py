@@ -58,6 +58,8 @@ class RuntimeOptions:
     cd_interval: int = 128         # steps between cycle-detector scans
     #   (≙ --ponycdinterval default 100ms, start.c:206)
     noblock: bool = False          # ≙ --ponynoblock: disable cycle detection
+    gc_max_iters: int = 0          # reachability-trace hop cap (0 = run to
+    #   fixpoint); if hit, that GC round collects nothing (safe)
     noyield: bool = False          # ≙ --ponynoyield: ignore yield hints
     max_steps: Optional[int] = None  # safety valve for tests
 

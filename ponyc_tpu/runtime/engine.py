@@ -451,7 +451,9 @@ def build_step(program: Program, opts: RuntimeOptions):
                              tc.local_capacity)
             ts = dict(new_type_state[tname])
             for fname in ts:
-                ts[fname] = ts[fname].at[cols].set(0, mode="drop")
+                default = (-1 if tc.atype.field_specs[fname] is pack.Ref
+                           else 0)
+                ts[fname] = ts[fname].at[cols].set(default, mode="drop")
             new_type_state[tname] = ts
 
         # --- 3. route (mesh) or pass through (single chip).
@@ -506,6 +508,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         # step; its remaining queue is discarded (head := tail), flags
         # clear, and the row becomes reclaimable by a later spawn.
         new_tail = res.tail
+        pinned = st.pinned
         n_destroyed = jnp.int32(0)
         for s0, dstr in destroy_rows:
             if dstr is None:
@@ -517,6 +520,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                 jnp.take(new_tail, jnp.minimum(rows, nl - 1)), mode="drop")
             muted = muted.at[rows].set(False, mode="drop")
             mute_ref = mute_ref.at[rows].set(-1, mode="drop")
+            pinned = pinned.at[rows].set(False, mode="drop")
             n_destroyed = n_destroyed + jnp.sum(dstr.astype(jnp.int32))
 
         # --- 5. mute bookkeeping (≙ ponyint_mute_actor, actor.c:1171-1207).
@@ -586,7 +590,7 @@ def build_step(program: Program, opts: RuntimeOptions):
 
         st2 = RtState(
             buf=res.buf, head=new_head, tail=new_tail,
-            alive=alive, muted=muted2, mute_ref=mute_ref2,
+            alive=alive, muted=muted2, mute_ref=mute_ref2, pinned=pinned,
             dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
             dspill_words=res.spill.words,
             dspill_count=vec(res.spill_count),
@@ -605,6 +609,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_spawned=vec(st.n_spawned[0] + n_spawned),
             n_destroyed=vec(st.n_destroyed[0] + n_destroyed),
             spawn_fail=vec(spawn_fail, jnp.bool_),
+            n_collected=st.n_collected,
             type_state=new_type_state,
         )
         aux = StepAux(

@@ -1,0 +1,233 @@
+"""Actor garbage collection: whole-world parallel reachability tracing.
+
+≙ the reference's actor-collection machinery, re-designed for TPU:
+
+- ORCA deferred reference counting (src/libponyrt/gc/gc.c:38-435,
+  actormap/objectmap) exists because *distributed tracing is impractical
+  on CPUs* — actors would have to pause each other. On a TPU the whole
+  actor world is one address space of SoA columns, so the idiomatic
+  equivalent is a synchronous parallel trace: mark everything reachable
+  from the roots with a vectorised frontier propagation, one masked
+  scatter per hop, `lax.while_loop` to fixpoint.
+- The cycle detector (gc/cycle.c:345-651 scan_grey/collect + CNF/ACK)
+  exists because reference counting can't see cycles. Tracing collects
+  cycles for free — a cycle of blocked actors unreachable from any root
+  is simply never marked.
+
+Roots (≙ "rc > 0" in ORCA terms):
+  - host-pinned actors (Runtime.spawn pins; release() unpins) ≙ the
+    external/application reference an actor is born with (actor.c:688);
+  - actors with queued or in-flight (spilled) messages ≙ messages hold
+    rc while in flight (ORCA's send-increment rule);
+  - muted actors (they have rejected traffic parked in a spill);
+  - host-cohort rows (host actors are host-managed, never collected);
+  - extra host-side roots passed per collection: refs held in host-actor
+    state dicts and in the pending inject queue.
+
+Edges: Ref-typed state fields of live actors, and Ref-typed arguments of
+every queued/spilled message (the behaviour signature's Ref annotations
+are the trace functions ≙ the compiler-generated gentrace.c ones).
+
+Termination: each iteration extends reachability by one hop, so the loop
+runs at most graph-diameter times; `gc_max_iters` (0 = unbounded) caps
+pathological chains — if the cap is hit before fixpoint, *nothing* is
+collected that round (conservative, always safe).
+
+Collection frees the slot (alive=False) — the row becomes claimable by
+ctx.spawn / Runtime.spawn. Sends to a collected actor dead-letter, which
+Pony's type system makes unrepresentable; here it is a counted drop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import RuntimeOptions
+from ..program import Program
+from .state import RtState
+
+
+def build_ref_arg_mask(program: Program, msg_words: int) -> np.ndarray:
+    """Static [n_gids, msg_words] bool: which payload words of each
+    behaviour message are actor refs (≙ the per-type trace function the
+    compiler emits, gentrace.c — here derived from Ref annotations)."""
+    from ..ops.pack import Ref
+    n = len(program.behaviour_table)
+    mask = np.zeros((max(n, 1), msg_words), bool)
+    for gid, bdef in enumerate(program.behaviour_table):
+        for i, spec in enumerate(bdef.arg_specs):
+            if spec is Ref and i < msg_words:
+                mask[gid, i] = True
+    return mask
+
+
+def _ref_fields(cohort):
+    from ..ops.pack import Ref
+    return [f for f, spec in cohort.atype.field_specs.items() if spec is Ref]
+
+
+def build_gc(program: Program, opts: RuntimeOptions):
+    """Trace the collection pass; returns local_gc(state, extra_roots)
+    → (state, (n_collected_total, converged, iters)) in per-shard
+    coordinates (wrap like the step: jit for P=1, shard_map for P>1)."""
+    assert program.frozen
+    p = program.shards
+    nl = program.n_local
+    ntot = p * nl
+    fh = program.first_host_row
+    cap = opts.mailbox_cap
+    ref_mask_np = build_ref_arg_mask(program, opts.msg_words)
+    any_ref_args = bool(ref_mask_np.any())
+    n_gids = ref_mask_np.shape[0]
+    max_iters = opts.gc_max_iters
+
+    def local_gc(st: RtState, extra_roots):
+        if p > 1:
+            shard = lax.axis_index("actors").astype(jnp.int32)
+        else:
+            shard = jnp.int32(0)
+        base = shard * nl
+        occ = st.tail - st.head
+        rows = jnp.arange(nl, dtype=jnp.int32)
+
+        # --- roots ---
+        roots = (st.pinned | extra_roots | (occ > 0) | st.muted
+                 | (rows >= fh))
+
+        # Initial global marks: local roots + in-flight spill traffic.
+        marks0 = jnp.zeros((ntot,), jnp.bool_).at[
+            jnp.where(roots, base + rows, ntot)].max(True, mode="drop")
+        for tgt_arr, words_arr in (
+                (jnp.where(st.dspill_tgt >= 0, base + st.dspill_tgt, -1),
+                 st.dspill_words),
+                (st.rspill_tgt, st.rspill_words)):
+            marks0 = marks0.at[jnp.where(tgt_arr >= 0, tgt_arr, ntot)].max(
+                True, mode="drop")
+            if any_ref_args:
+                g = jnp.clip(words_arr[:, 0], 0, n_gids - 1)
+                rm = (jnp.asarray(ref_mask_np)[g]
+                      & (words_arr[:, :1] >= 0) & (words_arr[:, :1] < n_gids)
+                      & (tgt_arr[:, None] >= 0))
+                refs = jnp.where(rm, words_arr[:, 1:], -1)
+                marks0 = marks0.at[
+                    jnp.where(refs >= 0, refs, ntot).reshape(-1)].max(
+                    True, mode="drop")
+
+        # Pre-extract edges (targets are global ids; sources are local).
+        # State-field edges, one [local_cap] target column per Ref field.
+        field_edges = []   # (src_slice_start, src_slice_stop, targets)
+        for cohort in program.device_cohorts:
+            for fname in _ref_fields(cohort):
+                col = st.type_state[cohort.atype.__name__][fname]
+                field_edges.append((cohort.local_start, cohort.local_stop,
+                                    col.astype(jnp.int32)))
+        # Mailbox edges: ref args of queued messages, [nl, cap, W].
+        if any_ref_args:
+            k = jnp.arange(cap, dtype=jnp.int32)
+            idx = (st.head[:, None] + k[None, :]) % cap
+            msgs = jnp.take_along_axis(st.buf, idx[:, :, None], axis=1)
+            valid = k[None, :] < occ[:, None]
+            g = jnp.clip(msgs[:, :, 0], 0, n_gids - 1)
+            inr = (msgs[:, :, 0] >= 0) & (msgs[:, :, 0] < n_gids)
+            rm = (jnp.asarray(ref_mask_np)[g]
+                  & valid[:, :, None] & inr[:, :, None])
+            mb_tgt = jnp.where(rm, msgs[:, :, 1:], -1)   # [nl, cap, W]
+        else:
+            mb_tgt = None
+
+        def propagate(live):
+            """One hop: mark every target referenced by a live source."""
+            marks = jnp.zeros((ntot,), jnp.bool_).at[
+                jnp.where(live, base + rows, ntot)].max(True, mode="drop")
+            for s0, s1, tgt in field_edges:
+                src_ok = live[s0:s1] & st.alive[s0:s1] & (tgt >= 0)
+                marks = marks.at[jnp.where(src_ok, tgt, ntot)].max(
+                    True, mode="drop")
+            if mb_tgt is not None:
+                src_ok = live[:, None, None] & (mb_tgt >= 0)
+                marks = marks.at[
+                    jnp.where(src_ok, mb_tgt, ntot).reshape(-1)].max(
+                    True, mode="drop")
+            return marks
+
+        def glob(marks):
+            if p > 1:
+                marks = lax.psum(marks.astype(jnp.int32), "actors") > 0
+            return lax.dynamic_slice(marks, (base,), (nl,))
+
+        live0 = glob(marks0)
+
+        def cond(carry):
+            _, changed, it = carry
+            going = changed
+            if max_iters:
+                going = going & (it < max_iters)
+            return going
+
+        def body(carry):
+            live, _, it = carry
+            new_live = live | glob(propagate(live))
+            ch = jnp.any(new_live != live)
+            if p > 1:
+                ch = lax.psum(ch.astype(jnp.int32), "actors") > 0
+            return new_live, ch, it + 1
+
+        live, changed, iters = lax.while_loop(
+            cond, body, (live0, jnp.bool_(True), jnp.int32(0)))
+        converged = ~changed
+
+        # --- collect (only on a converged trace; ≙ cycle.c `collect`) ---
+        dead = st.alive & ~live & (rows < fh) & converged
+        n_dead = jnp.sum(dead.astype(jnp.int32))
+        st2 = RtState(
+            buf=st.buf,
+            head=jnp.where(dead, st.tail, st.head),
+            tail=st.tail,
+            alive=st.alive & ~dead,
+            muted=st.muted & ~dead,
+            mute_ref=jnp.where(dead, -1, st.mute_ref),
+            pinned=st.pinned & ~dead,
+            dspill_tgt=st.dspill_tgt, dspill_sender=st.dspill_sender,
+            dspill_words=st.dspill_words, dspill_count=st.dspill_count,
+            rspill_tgt=st.rspill_tgt, rspill_sender=st.rspill_sender,
+            rspill_words=st.rspill_words, rspill_count=st.rspill_count,
+            spill_overflow=st.spill_overflow,
+            exit_flag=st.exit_flag, exit_code=st.exit_code,
+            step_no=st.step_no,
+            n_processed=st.n_processed, n_delivered=st.n_delivered,
+            n_rejected=st.n_rejected, n_badmsg=st.n_badmsg,
+            n_deadletter=st.n_deadletter, n_mutes=st.n_mutes,
+            n_spawned=st.n_spawned, n_destroyed=st.n_destroyed,
+            spawn_fail=st.spawn_fail,
+            n_collected=st.n_collected + n_dead.reshape(1),
+            type_state=st.type_state,
+        )
+        if p > 1:
+            n_dead = lax.psum(n_dead, "actors")
+        return st2, (n_dead, converged, iters)
+
+    return local_gc
+
+
+def jit_gc(program: Program, opts: RuntimeOptions, mesh=None):
+    """Jit the collection pass (shard_map over 'actors' when meshed)."""
+    gc = build_gc(program, opts)
+    if program.shards == 1:
+        return jax.jit(gc, donate_argnums=(0,))
+    from jax.sharding import PartitionSpec as P
+    from .engine import _state_structure
+    sharded = P("actors")
+    repl = P()
+    state_spec = jax.tree.map(lambda _: sharded,
+                              _state_structure(program, opts))
+    mapped = jax.shard_map(
+        gc, mesh=mesh,
+        in_specs=(state_spec, sharded),
+        out_specs=(state_spec, (repl, repl, repl)),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,))

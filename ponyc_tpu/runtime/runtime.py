@@ -125,6 +125,10 @@ class Runtime:
         self.steps_run = 0
         self.totals = collections.Counter()    # lifetime stats (host ints)
         self._last_counters: Dict[str, int] = {}
+        self._gc_fn = None
+        self._ref_mask = None
+        self._ever_released = False
+        self._last_gc_step = 0
 
     # ---- construction (≙ pony_init) ----
     def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
@@ -192,7 +196,9 @@ class Runtime:
             for i, gid in enumerate(ids):
                 st = {}
                 for fname in atype.field_specs:
-                    v = fields.get(fname, 0)
+                    default = (-1 if atype.field_specs[fname] is pack.Ref
+                               else 0)
+                    v = fields.get(fname, default)
                     v = np.asarray(v)
                     st[fname] = v.reshape(-1)[i % max(v.size, 1)].item() \
                         if v.ndim else v.item()
@@ -204,13 +210,73 @@ class Runtime:
                     val = jnp.asarray(fields[fname]).astype(ts[fname].dtype)
                     val = jnp.broadcast_to(val, (count,) if val.ndim == 0
                                            else val.shape)
-                    ts[fname] = ts[fname].at[cols].set(val)
+                else:
+                    # Reused slots must not leak a previous life's state.
+                    val = jnp.full((count,), -1 if spec is pack.Ref else 0,
+                                   ts[fname].dtype)
+                ts[fname] = ts[fname].at[cols].set(val)
             new_ts = dict(self.state.type_state)
             new_ts[atype.__name__] = ts
             self.state = self._replace(type_state=new_ts)
         self.state = self._replace(
-            alive=self.state.alive.at[ids].set(True))
+            alive=self.state.alive.at[ids].set(True),
+            # The caller now holds these refs: GC roots until release().
+            pinned=self.state.pinned.at[ids].set(True))
         return ids
+
+    # ---- GC pinning (≙ ORCA's external rc: an actor is born with one
+    # reference owned by its creator, actor.c:688-734) ----
+    def release(self, ids) -> None:
+        """Drop the host's reference(s): the actors become collectable as
+        soon as they are unreachable and message-quiet (gc.py)."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        self.state = self._replace(
+            pinned=self.state.pinned.at[ids].set(False))
+        self._ever_released = True
+
+    def pin(self, ids) -> None:
+        """(Re-)pin actors as host-held GC roots."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        self.state = self._replace(
+            pinned=self.state.pinned.at[ids].set(True))
+
+    def gc(self) -> int:
+        """Run one collection: trace reachability from the roots, free
+        everything unreached (≙ ORCA + the cycle detector in one pass —
+        see gc.py). Returns the number of actors collected."""
+        if self.state is None:
+            raise RuntimeError("call start() first")
+        if self._gc_fn is None:
+            from . import gc as gc_mod
+            self._gc_fn = gc_mod.jit_gc(self.program, self.opts, self.mesh)
+            self._ref_mask = gc_mod.build_ref_arg_mask(
+                self.program, self.opts.msg_words)
+        # Host-side roots: refs in host-actor state dicts and in pending
+        # inject messages (they will reach the device eventually).
+        extra = np.zeros((self.program.total,), bool)
+        for aid, stt in self._host_state.items():
+            cohort = self.program.cohort_of(aid)
+            for fname, spec in cohort.atype.field_specs.items():
+                if spec is pack.Ref:
+                    v = int(stt.get(fname, -1))
+                    if 0 <= v < self.program.total:
+                        extra[v] = True
+        for t, w in self._inject_q:
+            if 0 <= t < self.program.total:
+                extra[t] = True
+            gid = int(w[0])
+            if 0 <= gid < self._ref_mask.shape[0]:
+                for i in np.nonzero(self._ref_mask[gid])[0]:
+                    v = int(w[1 + i])
+                    if 0 <= v < self.program.total:
+                        extra[v] = True
+        before = self.counter("n_collected")
+        self.state, (n, converged, iters) = self._gc_fn(
+            self.state, jnp.asarray(extra))
+        self.totals["gc_runs"] += 1
+        if not bool(converged):
+            self.totals["gc_aborted"] += 1
+        return self.counter("n_collected") - before
 
     def _replace(self, **kw) -> RtState:
         import dataclasses as _dc
@@ -427,6 +493,17 @@ class Runtime:
                 self._drain_host()
             for p in self._bridge_pollers:
                 p.poll(self)
+            # Periodic collection (≙ the cycle detector triggered off the
+            # scheduler-0 idle path every --ponycdinterval,
+            # scheduler.c:976-989) — only when something can actually be
+            # garbage: a host ref was released or actors spawn on device.
+            if (not self.opts.noblock and self.opts.cd_interval > 0
+                    and (self._ever_released
+                         or self.program.has_device_spawns)
+                    and (self.steps_run - self._last_gc_step
+                         >= self.opts.cd_interval)):
+                self._last_gc_step = self.steps_run
+                self.gc()
             if self._exit_requested:
                 break
             busy = (bool(a.device_pending) or bool(a.host_pending)

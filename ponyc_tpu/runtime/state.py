@@ -58,6 +58,8 @@ class RtState:
     muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
     mute_ref: jnp.ndarray     # [N] int32 — global id of the muting
     #                              receiver (may be off-shard); -1 = none
+    pinned: jnp.ndarray       # [N] bool — host holds a ref (GC root,
+    #                              ≙ ORCA external rc; see runtime/gc.py)
 
     # Receiver-side overflow spill (local-row targets).
     dspill_tgt: jnp.ndarray    # [P*S] int32 local row, -1 = empty slot
@@ -89,6 +91,7 @@ class RtState:
     n_spawned: jnp.ndarray    # [P] int32 — device-side ctx.spawn() claims
     n_destroyed: jnp.ndarray  # [P] int32 — ctx.destroy() completions
     spawn_fail: jnp.ndarray   # [P] bool — sticky: a wanted spawn had no slot
+    n_collected: jnp.ndarray  # [P] int32 — actors freed by GC (gc.py)
 
     # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
     # (leading axis shard-major; see Cohort.slot_to_col).
@@ -109,9 +112,12 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     for cohort in program.cohorts:
         fields = {}
         for fname, spec in cohort.atype.field_specs.items():
-            from ..ops.pack import F32
+            from ..ops.pack import F32, Ref
             dtype = jnp.float32 if spec is F32 else jnp.int32
-            fields[fname] = jnp.zeros((cohort.capacity,), dtype)
+            # Ref fields default to -1 ("no actor") — id 0 is a real
+            # actor, and the GC tracer treats >= 0 as an edge.
+            fields[fname] = jnp.full((cohort.capacity,),
+                                     -1 if spec is Ref else 0, dtype)
         type_state[cohort.atype.__name__] = fields
 
     return RtState(
@@ -121,6 +127,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         alive=jnp.zeros((n,), jnp.bool_),
         muted=jnp.zeros((n,), jnp.bool_),
         mute_ref=jnp.full((n,), -1, i32),
+        pinned=jnp.zeros((n,), jnp.bool_),
         dspill_tgt=jnp.full((s,), -1, i32),
         dspill_sender=jnp.full((s,), -1, i32),
         dspill_words=jnp.zeros((s, w1), i32),
@@ -142,5 +149,6 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_spawned=jnp.zeros((p,), i32),
         n_destroyed=jnp.zeros((p,), i32),
         spawn_fail=jnp.zeros((p,), jnp.bool_),
+        n_collected=jnp.zeros((p,), i32),
         type_state=type_state,
     )

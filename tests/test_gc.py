@@ -1,0 +1,147 @@
+"""Actor GC: reachability tracing ≙ ORCA rc + cycle detector.
+
+The reference collects an actor when it is blocked with rc 0
+(gc/gc.c, actor.c:528-544) and collects *cycles* of blocked actors via
+the cycle-detector actor (gc/cycle.c:345-651). Here both are one
+parallel trace (runtime/gc.py); these tests pin down the same
+observable semantics: unreachable+quiet ⇒ collected, reachable or
+message-holding ⇒ kept, cycles ⇒ collected, host refs ⇒ roots.
+"""
+
+import numpy as np
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+
+@actor
+class Node:
+    next_ref: Ref
+    hits: I32
+
+    @behaviour
+    def link(self, st, to: Ref):
+        return {**st, "next_ref": to}
+
+    @behaviour
+    def poke(self, st):
+        return {**st, "hits": st["hits"] + 1}
+
+    @behaviour
+    def forward(self, st, to: Ref):
+        # Holds a ref in a *message* to itself, not in any state field.
+        self.send(self.actor_id, Node.forward_sink, to, when=False)
+        return st
+
+    @behaviour
+    def forward_sink(self, st, to: Ref):
+        return st
+
+
+def _mk(cap=8, **kw):
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=2,
+                          inject_slots=8, spill_cap=64, **kw)
+    rt = Runtime(opts).declare(Node, cap)
+    return rt.start()
+
+
+def test_released_unreachable_actor_is_collected():
+    rt = _mk()
+    ids = rt.spawn_many(Node, 4)
+    rt.release(ids[2:])                 # host drops two refs
+    assert rt.gc() == 2
+    alive = np.asarray(rt.state.alive)
+    assert alive.sum() == 2 and alive[ids[0]] and alive[ids[1]]
+
+
+def test_state_field_ref_keeps_actor_alive():
+    rt = _mk()
+    a, b, c = rt.spawn_many(Node, 3)
+    rt.send(int(a), Node.link, int(b))  # a.next_ref = b
+    rt.run(max_steps=5)
+    rt.release([b, c])
+    assert rt.gc() == 1                 # only c: b is reachable from a
+    alive = np.asarray(rt.state.alive)
+    assert alive[a] and alive[b] and not alive[c]
+
+
+def test_chain_reachability_is_transitive():
+    rt = _mk(cap=8)
+    ids = rt.spawn_many(Node, 6)
+    for i in range(5):                  # 0 → 1 → 2 → 3 → 4 → 5
+        rt.send(int(ids[i]), Node.link, int(ids[i + 1]))
+    rt.run(max_steps=5)
+    rt.release(ids[1:])
+    assert rt.gc() == 0                 # whole chain hangs off ids[0]
+    rt.release(ids[:1])
+    assert rt.gc() == 6                 # now the entire chain goes
+
+
+def test_cycle_of_garbage_is_collected():
+    # ≙ the cycle detector's whole purpose (gc/cycle.c): rc alone never
+    # frees a ring that references itself.
+    rt = _mk()
+    ids = rt.spawn_many(Node, 4)
+    for i in range(4):
+        rt.send(int(ids[i]), Node.link, int(ids[(i + 1) % 4]))
+    rt.run(max_steps=5)
+    rt.release(ids)
+    assert rt.gc() == 4
+    assert np.asarray(rt.state.alive).sum() == 0
+
+
+def test_pending_message_is_a_root():
+    rt = _mk()
+    a, b = rt.spawn_many(Node, 2)
+    rt.release([a, b])
+    rt.send(int(a), Node.poke)          # queued via inject → host root now,
+    assert rt.gc() == 1                 # only b collected
+    rt.run(max_steps=5)                 # deliver + drain
+    assert rt.state_of(int(a))["hits"] == 1
+    assert rt.gc() == 1                 # quiet again → a goes too
+
+
+def test_message_ref_arg_is_an_edge():
+    rt = _mk()
+    a, b = rt.spawn_many(Node, 2)
+    # A message *in a's mailbox* carries b's ref; b has no other root.
+    rt.bulk_send([int(a)], Node.link, [int(b)])
+    rt.release([b])
+    assert rt.gc() == 0                 # ref inside queued message
+    rt.run(max_steps=5)                 # now a.next_ref = b (state edge)
+    assert rt.gc() == 0
+    rt.send(int(a), Node.link, -1)      # overwrite the field: b unreachable
+    rt.run(max_steps=5)
+    assert rt.gc() == 1
+
+
+def test_auto_gc_in_run_loop():
+    rt = _mk(cap=8, cd_interval=4)
+    ids = rt.spawn_many(Node, 4)
+    rt.release(ids[2:])
+    # Keep the runtime busy past cd_interval steps: ping-pong traffic.
+    for i in range(12):
+        rt.send(int(ids[0]), Node.poke)
+        rt.run(max_steps=2)
+    assert rt.counter("n_collected") == 2
+    # Collected slots are reclaimable by host spawn.
+    rt.spawn(Node)
+    assert np.asarray(rt.state.alive).sum() == 3
+
+
+def test_gc_on_mesh_crosses_shards():
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=2,
+                          inject_slots=8, spill_cap=64, mesh_shards=4)
+    rt = Runtime(opts).declare(Node, 16).start()
+    ids = rt.spawn_many(Node, 16)
+    nl = rt.program.n_local
+    # Cross-shard chain: each node links one on the *next* shard.
+    order = sorted(range(16), key=lambda s: int(ids[s]) // nl)
+    for i in range(15):
+        rt.send(int(ids[order[i]]), Node.link, int(ids[order[i + 1]]))
+    rt.run(max_steps=10)
+    rt.release(ids)
+    rt.pin([ids[order[0]]])
+    assert rt.gc() == 0                 # chain root pinned: all reachable
+    rt.release([ids[order[0]]])
+    assert rt.gc() == 16
+    assert np.asarray(rt.state.alive).sum() == 0
