@@ -76,7 +76,7 @@ def main():
     def dispatch_only(state):
         occ = state.tail - state.head
         return disp(state.type_state[ch.atype.__name__], state.buf,
-                    state.head, occ, state.alive, idsj)
+                    state.head, occ, state.alive, idsj, {})
 
     timeit("dispatch only (drain+switch+outbox)", dispatch_only, st)
 

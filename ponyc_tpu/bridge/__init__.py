@@ -39,6 +39,7 @@ class Bridge:
         self.rt = rt
         self.loop = native.AsioLoop()
         self._subs: Dict[int, BehaviourDef] = {}
+        self._cbs: Dict[int, object] = {}   # internal callback subscribers
         self._noisy_given = 0     # noisy holds mirrored into the runtime
 
     # -- subscriptions (≙ pony_asio_event_create/subscribe) --
@@ -91,14 +92,32 @@ class Bridge:
         SIGTERM live-actor dump, analysis.c:55, cycle.c:874-954)."""
         return self.signal(owner, bdef, _signal.SIGTERM)
 
+    def fd_callback(self, fd: int, fn, *, read: bool = True,
+                    write: bool = False, noisy: bool = True) -> int:
+        """Subscribe an fd whose events are handled by a host-side Python
+        callback `fn(event)` at poll boundaries instead of an actor
+        behaviour — used by runtime-internal subsystems (the net layer's
+        accept/recv plumbing ≙ the reference doing the syscalls inside
+        lang/socket.c before the stdlib actor sees data)."""
+        sid = self.loop.fd(fd, -1, -1, read=read, write=write,
+                           oneshot=False, noisy=noisy)
+        self._cbs[sid] = fn
+        return sid
+
     def unsubscribe(self, sub_id: int) -> bool:
         self._subs.pop(sub_id, None)
+        self._cbs.pop(sub_id, None)
         return self.loop.unsubscribe(sub_id)
 
     # -- poller protocol (called by Runtime.run at host boundaries) --
     def poll(self, rt) -> int:
         n = 0
         for ev in self.loop.drain():
+            cb = self._cbs.get(ev.sub_id)
+            if cb is not None:
+                cb(ev)
+                n += 1
+                continue
             bdef = self._subs.get(ev.sub_id)
             if bdef is None:      # unsubscribed with events still queued
                 continue

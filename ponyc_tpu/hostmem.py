@@ -1,0 +1,69 @@
+"""Host object heap: rich payloads referenced from int32 messages.
+
+≙ the reference's per-actor heaps + ORCA ownership transfer for message
+payloads (src/libponyrt/mem/heap.c; gc/gc.c send/recv object handlers):
+a Pony message carries a *pointer* into some actor's heap and ORCA moves
+the reference count with it. Device mailboxes here are fixed int32 words,
+so host-side objects (socket buffers, strings, arbitrary Python values)
+live in this handle table and messages carry the handle.
+
+Ownership is *move* semantics — `unbox` consumes the handle — which is
+exactly Pony's `iso` send (the common case for network buffers: the
+sender provably loses access, so no GC protocol is needed at all). Use
+`peek` for read-only access without consuming, `drop` to discard.
+
+Accounting mirrors the reference's USE_MEMTRACK counters
+(scheduler.h:52-66): boxed/unboxed/live and peak-live are queryable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class HostHeap:
+    """Handle table with move-on-unbox semantics (≙ iso message payloads).
+
+    Handles are positive int32s; 0/-1 never issued (they collide with the
+    framework's "empty word" / "no ref" conventions)."""
+
+    def __init__(self):
+        self._objs: Dict[int, Any] = {}
+        self._next = 1
+        self.boxed = 0
+        self.unboxed = 0
+        self.peak_live = 0
+
+    def box(self, obj: Any) -> int:
+        h = self._next
+        self._next += 1
+        if self._next >= 2**31:         # wrap, skipping live handles
+            self._next = 1
+        while self._next in self._objs:
+            self._next += 1
+        self._objs[h] = obj
+        self.boxed += 1
+        self.peak_live = max(self.peak_live, len(self._objs))
+        return h
+
+    def unbox(self, handle: int) -> Any:
+        """Take ownership (the handle dies). KeyError on double-take —
+        the dynamic cousin of Pony rejecting use-after-send of an iso."""
+        obj = self._objs.pop(int(handle))
+        self.unboxed += 1
+        return obj
+
+    def peek(self, handle: int) -> Any:
+        return self._objs[int(handle)]
+
+    def drop(self, handle: int) -> None:
+        if self._objs.pop(int(handle), None) is not None:
+            self.unboxed += 1
+
+    @property
+    def live(self) -> int:
+        return len(self._objs)
+
+    def stats(self) -> Dict[str, int]:
+        return {"boxed": self.boxed, "unboxed": self.unboxed,
+                "live": self.live, "peak_live": self.peak_live}

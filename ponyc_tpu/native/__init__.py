@@ -90,6 +90,9 @@ def lib() -> ctypes.CDLL:
                                     c.c_int32]
         l.ponyx_asio_unsubscribe.restype = c.c_int32
         l.ponyx_asio_unsubscribe.argtypes = [c.c_void_p, c.c_int32]
+        l.ponyx_asio_fd_interest.restype = c.c_int32
+        l.ponyx_asio_fd_interest.argtypes = [c.c_void_p, c.c_int32,
+                                             c.c_int32]
         l.ponyx_asio_drain.restype = c.c_int32
         l.ponyx_asio_drain.argtypes = [c.c_void_p,
                                        c.POINTER(c.c_int32), c.c_int32]
@@ -99,6 +102,41 @@ def lib() -> ctypes.CDLL:
         l.ponyx_asio_noisy_remove.argtypes = [c.c_void_p]
         l.ponyx_asio_noisy_count.restype = c.c_int64
         l.ponyx_asio_noisy_count.argtypes = [c.c_void_p]
+
+        u8p = c.POINTER(c.c_uint8)
+        l.ponyx_os_listen_tcp.restype = c.c_int32
+        l.ponyx_os_listen_tcp.argtypes = [c.c_char_p, c.c_int32, c.c_int32]
+        l.ponyx_os_connect_tcp.restype = c.c_int32
+        l.ponyx_os_connect_tcp.argtypes = [c.c_char_p, c.c_int32]
+        l.ponyx_os_accept.restype = c.c_int32
+        l.ponyx_os_accept.argtypes = [c.c_int32]
+        l.ponyx_os_connect_result.restype = c.c_int32
+        l.ponyx_os_connect_result.argtypes = [c.c_int32]
+        l.ponyx_os_recv.restype = c.c_int32
+        l.ponyx_os_recv.argtypes = [c.c_int32, u8p, c.c_int32]
+        l.ponyx_os_send.restype = c.c_int32
+        l.ponyx_os_send.argtypes = [c.c_int32, u8p, c.c_int32]
+        l.ponyx_os_udp.restype = c.c_int32
+        l.ponyx_os_udp.argtypes = [c.c_char_p, c.c_int32]
+        l.ponyx_os_sendto.restype = c.c_int32
+        l.ponyx_os_sendto.argtypes = [c.c_int32, u8p, c.c_int32,
+                                      c.c_char_p, c.c_int32]
+        l.ponyx_os_recvfrom.restype = c.c_int32
+        l.ponyx_os_recvfrom.argtypes = [c.c_int32, u8p, c.c_int32,
+                                        c.c_char_p, c.c_int32,
+                                        c.POINTER(c.c_int32)]
+        l.ponyx_os_sockname_port.restype = c.c_int32
+        l.ponyx_os_sockname_port.argtypes = [c.c_int32]
+        l.ponyx_os_peername_port.restype = c.c_int32
+        l.ponyx_os_peername_port.argtypes = [c.c_int32]
+        l.ponyx_os_nodelay.restype = c.c_int32
+        l.ponyx_os_nodelay.argtypes = [c.c_int32, c.c_int32]
+        l.ponyx_os_keepalive.restype = c.c_int32
+        l.ponyx_os_keepalive.argtypes = [c.c_int32, c.c_int32]
+        l.ponyx_os_shutdown.restype = c.c_int32
+        l.ponyx_os_shutdown.argtypes = [c.c_int32]
+        l.ponyx_os_close.restype = c.c_int32
+        l.ponyx_os_close.argtypes = [c.c_int32]
         _lib = l
         return _lib
 
@@ -107,6 +145,116 @@ def pool_stats() -> Tuple[int, int]:
     """(live blocks, parked blocks) from the native pool allocator."""
     l = lib()
     return int(l.ponyx_pool_allocated()), int(l.ponyx_pool_recycled())
+
+
+class sockets:
+    """Thin typed façade over the native socket ops (socket.cc ≙
+    src/libponyrt/lang/socket.c). All fds are non-blocking; -errno return
+    convention is translated to OSError except EAGAIN → None/b''."""
+
+    EAGAIN = 11
+    ESHUTDOWN = 108
+
+    @staticmethod
+    def _ck(r: int) -> int:
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+        return r
+
+    @classmethod
+    def listen_tcp(cls, host: str, port: int, backlog: int = 64) -> int:
+        return cls._ck(lib().ponyx_os_listen_tcp(
+            host.encode(), port, backlog))
+
+    @classmethod
+    def connect_tcp(cls, host: str, port: int) -> int:
+        return cls._ck(lib().ponyx_os_connect_tcp(host.encode(), port))
+
+    @classmethod
+    def accept(cls, listen_fd: int) -> Optional[int]:
+        r = lib().ponyx_os_accept(listen_fd)
+        if r == -cls.EAGAIN:
+            return None
+        return cls._ck(r)
+
+    @classmethod
+    def connect_result(cls, fd: int) -> int:
+        """0 = connected; else positive errno."""
+        return -int(lib().ponyx_os_connect_result(fd))
+
+    @classmethod
+    def recv(cls, fd: int, max_bytes: int = 65536):
+        """bytes (possibly empty=-EAGAIN → None) or b'' on orderly EOF."""
+        buf = (ctypes.c_uint8 * max_bytes)()
+        r = lib().ponyx_os_recv(fd, buf, max_bytes)
+        if r == -cls.EAGAIN:
+            return None
+        if r == -cls.ESHUTDOWN:
+            return b""
+        cls._ck(r)
+        return bytes(bytearray(buf[:r]))
+
+    @classmethod
+    def send(cls, fd: int, data: bytes) -> int:
+        """Bytes accepted (may be short); 0 when the kernel buffer is
+        full (wait for a write event)."""
+        n = len(data)
+        arr = (ctypes.c_uint8 * n).from_buffer_copy(data)
+        r = lib().ponyx_os_send(fd, arr, n)
+        if r == -cls.EAGAIN:
+            return 0
+        return cls._ck(r)
+
+    @classmethod
+    def udp(cls, host: str = "", port: int = 0) -> int:
+        return cls._ck(lib().ponyx_os_udp(host.encode(), port))
+
+    @classmethod
+    def sendto(cls, fd: int, data: bytes, host: str, port: int) -> int:
+        n = len(data)
+        arr = (ctypes.c_uint8 * n).from_buffer_copy(data)
+        r = lib().ponyx_os_sendto(fd, arr, n, host.encode(), port)
+        if r == -cls.EAGAIN:
+            return 0
+        return cls._ck(r)
+
+    @classmethod
+    def recvfrom(cls, fd: int, max_bytes: int = 65536):
+        """(data, host, port) or None when drained."""
+        buf = (ctypes.c_uint8 * max_bytes)()
+        addr = ctypes.create_string_buffer(64)
+        port = ctypes.c_int32(0)
+        r = lib().ponyx_os_recvfrom(fd, buf, max_bytes, addr, 64,
+                                    ctypes.byref(port))
+        if r == -cls.EAGAIN:
+            return None
+        cls._ck(r)
+        return (bytes(bytearray(buf[:r])), addr.value.decode(),
+                int(port.value))
+
+    @classmethod
+    def sockname_port(cls, fd: int) -> int:
+        return cls._ck(lib().ponyx_os_sockname_port(fd))
+
+    @classmethod
+    def peername_port(cls, fd: int) -> int:
+        return cls._ck(lib().ponyx_os_peername_port(fd))
+
+    @classmethod
+    def nodelay(cls, fd: int, on: bool = True) -> None:
+        cls._ck(lib().ponyx_os_nodelay(fd, int(on)))
+
+    @classmethod
+    def keepalive(cls, fd: int, secs: int) -> None:
+        cls._ck(lib().ponyx_os_keepalive(fd, secs))
+
+    @classmethod
+    def shutdown(cls, fd: int) -> None:
+        lib().ponyx_os_shutdown(fd)
+
+    @classmethod
+    def close(cls, fd: int) -> None:
+        lib().ponyx_os_close(fd)
 
 
 class HostQueue:
@@ -202,6 +350,14 @@ class AsioLoop:
 
     def unsubscribe(self, sub_id: int) -> bool:
         return bool(self._l.ponyx_asio_unsubscribe(self._h, sub_id))
+
+    def fd_interest(self, sub_id: int, *, read: bool = True,
+                    write: bool = False) -> None:
+        """Re-arm a live fd subscription's interest set (epoll MOD)."""
+        interest = (1 if read else 0) | (2 if write else 0)
+        r = self._l.ponyx_asio_fd_interest(self._h, sub_id, interest)
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
 
     def drain(self, max_events: int = 256) -> List[AsioEvent]:
         out = np.empty((max_events, 6), np.int32)

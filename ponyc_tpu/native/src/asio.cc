@@ -314,11 +314,14 @@ int32_t ponyx_asio_signal(ponyx_asio_t* l, int32_t signum, int32_t owner,
 }
 
 // Arbitrary fd (socket, pipe, stdin). interest: 1=read 2=write 3=both.
-// Level-triggered, matching the reference's default epoll mode.
+// Edge-triggered (≙ the reference arming epoll with EPOLLET for sockets,
+// epoll.c): one event per readiness *transition*, so a ready-but-undrained
+// fd cannot storm the event queue between host polls. Consumers must
+// drain to EAGAIN — which the net layer's accept/recv loops do.
 int32_t ponyx_asio_fd(ponyx_asio_t* l, int32_t fd, int32_t interest,
                       int32_t owner, int32_t behaviour, int32_t oneshot,
                       int32_t noisy) {
-  uint32_t flags = 0;
+  uint32_t flags = EPOLLET;
   if (interest & 1) flags |= EPOLLIN;
   if (interest & 2) flags |= EPOLLOUT;
   flags |= EPOLLRDHUP;
@@ -326,6 +329,29 @@ int32_t ponyx_asio_fd(ponyx_asio_t* l, int32_t fd, int32_t interest,
   *s = Sub{0, owner, behaviour, fd, kFdRead, false, oneshot != 0,
            noisy != 0, 0};
   return add_sub(l, s, flags);
+}
+
+// Change a live fd subscription's interest set (1=read 2=write 3=both);
+// ≙ pony_asio_event_resubscribe_read/write (asio/event.c) — the
+// reference's way of arming write-readiness only while writes are
+// pending, which is also exactly what the net layer does here.
+int32_t ponyx_asio_fd_interest(ponyx_asio_t* l, int32_t sub_id,
+                               int32_t interest) {
+  std::lock_guard<std::mutex> lock(l->mu);
+  auto it = l->subs.find(sub_id);
+  if (it == l->subs.end()) return -ENOENT;
+  Sub* s = it->second;
+  if (s->fd < 0) return -EINVAL;
+  struct epoll_event ev;
+  std::memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLET | EPOLLRDHUP;
+  if (interest & 1) ev.events |= EPOLLIN;
+  if (interest & 2) ev.events |= EPOLLOUT;
+  ev.data.fd = s->fd;
+  // MOD re-arms: if the fd is already ready for the new interest the
+  // kernel delivers a fresh edge — the property the write path relies on.
+  if (epoll_ctl(l->epfd, EPOLL_CTL_MOD, s->fd, &ev) != 0) return -errno;
+  return 0;
 }
 
 // ≙ pony_asio_event_unsubscribe (asio/event.c).

@@ -404,6 +404,23 @@ class Runtime:
             self.register_poller(self.bridge)
         return self.bridge
 
+    def attach_net(self):
+        """Create (once) the TCP/UDP layer (≙ packages/net over
+        lang/socket.c) on top of the bridge."""
+        if getattr(self, "net", None) is None:
+            from ..net import Net
+            self.net = Net(self)
+        return self.net
+
+    @property
+    def heap(self):
+        """Host object heap for rich message payloads (hostmem.py)."""
+        h = getattr(self, "_heap", None)
+        if h is None:
+            from ..hostmem import HostHeap
+            h = self._heap = HostHeap()
+        return h
+
     # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
     def _drain_host(self) -> bool:
         # Host cohorts only exist on single-shard runtimes (P=1), where
