@@ -1,0 +1,217 @@
+"""Async test harness — ≙ packages/ponytest.
+
+The reference's ponytest runs each `UnitTest` as its own actor under a
+`PonyTest` runner with per-test timeouts, assert helpers that *record*
+failures rather than abort, exclusion filters, expected-failure support,
+and (fork addition, DIVERGENCE.md) a `testsFinished` callback once the
+last test completes. The TPU framework's tests are actor *programs* (a
+Runtime run to quiescence), so the runner here drives one runtime per
+test with a watchdog timeout — the same structure, host-side.
+
+    class RingTest(UnitTest):
+        name = "ring/one-token"
+        def apply(self, h):
+            rt = build_ring(...)
+            h.assert_eq(rt.run(), 0)
+            h.assert_true(...)
+
+    runner = TestRunner()
+    runner.add(RingTest())
+    ok = runner.run()          # prints ponytest-style per-test lines
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import io
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+
+class TestHelper:
+    """Per-test context (≙ ponytest's TestHelper): assertions record
+    failures; `fail`/`complete` finish the test explicitly; `log` lines
+    surface only when the test fails (ponytest semantics)."""
+
+    __test__ = False      # not a pytest collection target
+
+    def __init__(self, name: str):
+        self.name = name
+        self.failures: List[str] = []
+        self.logs: List[str] = []
+        self._completed: Optional[bool] = None
+
+    # -- assertions (≙ TestHelper.assert_*) --
+    def assert_true(self, cond, msg: str = "") -> bool:
+        if not cond:
+            self._fail(f"assert_true failed {msg}")
+        return bool(cond)
+
+    def assert_false(self, cond, msg: str = "") -> bool:
+        if cond:
+            self._fail(f"assert_false failed {msg}")
+        return not cond
+
+    def assert_eq(self, a, b, msg: str = "") -> bool:
+        if not (a == b):
+            self._fail(f"assert_eq: {a!r} != {b!r} {msg}")
+            return False
+        return True
+
+    def assert_ne(self, a, b, msg: str = "") -> bool:
+        if a == b:
+            self._fail(f"assert_ne: both {a!r} {msg}")
+            return False
+        return True
+
+    def assert_error(self, fn: Callable, msg: str = "") -> bool:
+        """≙ assert_error: the callable must raise."""
+        try:
+            fn()
+        except Exception:
+            return True
+        self._fail(f"assert_error: no exception raised {msg}")
+        return False
+
+    def _fail(self, text: str) -> None:
+        self.failures.append(text)
+
+    def fail(self, text: str = "explicit fail") -> None:
+        self.failures.append(text)
+
+    def log(self, line: str) -> None:
+        self.logs.append(str(line))
+
+    def complete(self, success: bool) -> None:
+        """≙ TestHelper.complete for long tests."""
+        self._completed = bool(success)
+
+    @property
+    def ok(self) -> bool:
+        if self._completed is not None:
+            return self._completed and not self.failures
+        return not self.failures
+
+
+class UnitTest:
+    """≙ ponytest's UnitTest trait."""
+
+    name: str = ""
+    #: ≙ ponytest label/exclusion-group string
+    label: str = ""
+    #: Test passes only if apply() raises or records failures
+    #: (≙ ponytest's expected-failure pattern).
+    expect_failure: bool = False
+    #: Per-test timeout override in seconds (≙ long_test timeout).
+    timeout: Optional[float] = None
+
+    def apply(self, h: TestHelper) -> None:
+        raise NotImplementedError
+
+
+class TestResult:
+    __test__ = False      # not a pytest collection target
+    __slots__ = ("name", "ok", "elapsed_s", "failures", "logs", "timed_out")
+
+    def __init__(self, name, ok, elapsed_s, failures, logs, timed_out):
+        self.name = name
+        self.ok = ok
+        self.elapsed_s = elapsed_s
+        self.failures = failures
+        self.logs = logs
+        self.timed_out = timed_out
+
+
+class TestRunner:
+    """≙ the PonyTest runner actor (packages/ponytest/pony_test.pony):
+    sequential by default (runtimes share the process-global XLA client),
+    per-test timeout watchdog, `--only`-style filtering, summary line, and
+    the fork's testsFinished callback."""
+
+    __test__ = False      # not a pytest collection target
+
+    def __init__(self, *, default_timeout: float = 120.0,
+                 tests_finished: Optional[Callable] = None,
+                 out=None):
+        self.tests: List[UnitTest] = []
+        self.default_timeout = default_timeout
+        self.tests_finished = tests_finished
+        self.out = out or sys.stdout
+        self.results: List[TestResult] = []
+
+    def add(self, test: UnitTest) -> "TestRunner":
+        if not test.name:
+            test.name = type(test).__name__
+        self.tests.append(test)
+        return self
+
+    def _run_one(self, t: UnitTest) -> TestResult:
+        h = TestHelper(t.name)
+        timeout = t.timeout or self.default_timeout
+        err: List[str] = []
+        done = threading.Event()
+
+        def body():
+            try:
+                t.apply(h)
+            except Exception:
+                err.append(traceback.format_exc())
+            finally:
+                done.set()
+
+        t0 = time.time()
+        th = threading.Thread(target=body, daemon=True)
+        th.start()
+        timed_out = not done.wait(timeout)
+        elapsed = time.time() - t0
+        failures = list(h.failures)
+        if err:
+            failures.append(err[0])
+        if timed_out:
+            failures.append(f"timed out after {timeout}s")
+        ok = h.ok and not err and not timed_out
+        if t.expect_failure:
+            ok = not ok
+            failures = [] if ok else ["expected failure but test passed"]
+        return TestResult(t.name, ok, elapsed, failures, h.logs, timed_out)
+
+    def run(self, only: str = "*", exclude: str = "",
+            sequential: bool = True) -> bool:
+        """Run matching tests; returns overall success. `only`/`exclude`
+        are glob patterns on test names (≙ ponytest --only/--exclude)."""
+        selected = [t for t in self.tests
+                    if fnmatch.fnmatch(t.name, only)
+                    and not (exclude and fnmatch.fnmatch(t.name, exclude))]
+        w = self.out
+        print(f"{len(selected)} test(s) starting", file=w)
+        self.results = []
+        for t in selected:
+            r = self._run_one(t)
+            self.results.append(r)
+            mark = "OK  " if r.ok else "FAIL"
+            print(f"---- {mark} {r.name} ({r.elapsed_s*1e3:.0f} ms)",
+                  file=w)
+            if not r.ok:
+                for line in r.logs:
+                    print(f"       log: {line}", file=w)
+                for f in r.failures:
+                    print(f"       {f}", file=w)
+        n_ok = sum(1 for r in self.results if r.ok)
+        n_fail = len(self.results) - n_ok
+        print(f"---- {len(self.results)} test(s) ran: "
+              f"{n_ok} ok, {n_fail} failed", file=w)
+        if self.tests_finished is not None:
+            # ≙ the fork's testsFinished() hook (DIVERGENCE.md ponytest).
+            self.tests_finished(self.results)
+        return n_fail == 0
+
+
+def run_tests(*tests: UnitTest, **kw) -> bool:
+    """One-liner entry (≙ PonyTest's Main pattern)."""
+    r = TestRunner(**kw)
+    for t in tests:
+        r.add(t)
+    return r.run()

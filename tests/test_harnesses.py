@@ -1,0 +1,114 @@
+"""The test/bench harnesses themselves (≙ ponytest's own _test.pony and
+ponybench's examples)."""
+
+import io
+
+import jax.numpy as jnp
+
+from ponyc_tpu.benching import BenchRunner
+from ponyc_tpu.testing import TestHelper, TestRunner, UnitTest
+
+
+class _Pass(UnitTest):
+    name = "sample/pass"
+
+    def apply(self, h):
+        h.assert_eq(2 + 2, 4)
+        h.assert_true(True)
+
+
+class _Fail(UnitTest):
+    name = "sample/fail"
+
+    def apply(self, h):
+        h.log("some context")
+        h.assert_eq(1, 2, "intentional")
+
+
+class _ExpectFail(UnitTest):
+    name = "sample/expect-fail"
+    expect_failure = True
+
+    def apply(self, h):
+        h.fail("supposed to fail")
+
+
+class _Raises(UnitTest):
+    name = "sample/raises"
+
+    def apply(self, h):
+        h.assert_error(lambda: (_ for _ in ()).throw(ValueError()))
+
+
+class _TimesOut(UnitTest):
+    name = "sample/timeout"
+    timeout = 0.2
+
+    def apply(self, h):
+        import time
+        time.sleep(5)
+
+
+class _ActorProgram(UnitTest):
+    """A real runtime-driven test — the intended usage (≙ stdlib tests
+    running whole actor programs under ponytest)."""
+    name = "actor/ring"
+
+    def apply(self, h):
+        from ponyc_tpu import RuntimeOptions
+        from ponyc_tpu.models import ring
+        rt = ring.run(n_nodes=8, hops=16,
+                      opts=RuntimeOptions(mailbox_cap=8, batch=1,
+                                          max_sends=1, msg_words=1))
+        st = rt.cohort_state(ring.RingNode)
+        h.assert_eq(int(st["passes"].sum()), 16)
+
+
+def test_runner_semantics():
+    out = io.StringIO()
+    finished = []
+    r = TestRunner(out=out, tests_finished=finished.append)
+    for t in (_Pass(), _Fail(), _ExpectFail(), _Raises(), _TimesOut()):
+        r.add(t)
+    ok = r.run()
+    assert not ok
+    by = {x.name: x for x in r.results}
+    assert by["sample/pass"].ok
+    assert not by["sample/fail"].ok
+    assert "intentional" in " ".join(by["sample/fail"].failures)
+    assert "some context" in by["sample/fail"].logs
+    assert by["sample/expect-fail"].ok
+    assert by["sample/raises"].ok
+    assert by["sample/timeout"].timed_out and not by["sample/timeout"].ok
+    assert len(finished) == 1 and len(finished[0]) == 5
+    text = out.getvalue()
+    assert "5 test(s) ran: 3 ok, 2 failed" in text
+
+
+def test_runner_filters():
+    out = io.StringIO()
+    r = TestRunner(out=out)
+    r.add(_Pass()).add(_Fail())
+    assert r.run(only="sample/pass")
+    assert len(r.results) == 1
+    assert r.run(only="sample/*", exclude="sample/fail")
+
+
+def test_actor_program_under_harness():
+    out = io.StringIO()
+    assert TestRunner(out=out).add(_ActorProgram()).run()
+
+
+def test_bench_runner_scales_and_reports():
+    out = io.StringIO()
+    b = BenchRunner(min_window_s=0.02, out=out)
+    x = jnp.arange(4096, dtype=jnp.float32)
+    import jax
+    f = jax.jit(lambda v: (v * 2.0).sum())
+    r = b.bench("double-sum", f, x, items_per_call=x.size)
+    assert r.reps >= 1 and r.mean_s > 0
+    assert r.ops_per_s > 0
+    b.report()
+    b.report(json_lines=True)
+    text = out.getvalue()
+    assert "double-sum" in text and "ops_per_s" in text
