@@ -137,6 +137,15 @@ def lib() -> ctypes.CDLL:
         l.ponyx_os_shutdown.argtypes = [c.c_int32]
         l.ponyx_os_close.restype = c.c_int32
         l.ponyx_os_close.argtypes = [c.c_int32]
+
+        l.ponyx_os_process_spawn.restype = c.c_int64
+        l.ponyx_os_process_spawn.argtypes = [
+            c.c_char_p, c.POINTER(c.c_char_p), c.POINTER(c.c_char_p),
+            c.POINTER(c.c_int32)]
+        l.ponyx_os_process_check.restype = c.c_int32
+        l.ponyx_os_process_check.argtypes = [c.c_int64]
+        l.ponyx_os_process_kill.restype = c.c_int32
+        l.ponyx_os_process_kill.argtypes = [c.c_int64, c.c_int32]
         _lib = l
         return _lib
 
@@ -255,6 +264,42 @@ class sockets:
     @classmethod
     def close(cls, fd: int) -> None:
         lib().ponyx_os_close(fd)
+
+
+class processes:
+    """Native child-process ops (process.cc ≙ lang/process.c)."""
+
+    @staticmethod
+    def spawn(path: str, argv, env=None):
+        """Returns (pid, stdin_w, stdout_r, stderr_r); fds non-blocking."""
+        c = ctypes
+        av = (c.c_char_p * (len(argv) + 1))(
+            *[a.encode() if isinstance(a, str) else a for a in argv], None)
+        ev = None
+        if env is not None:
+            pairs = [f"{k}={v}".encode() for k, v in env.items()]
+            ev = (c.c_char_p * (len(pairs) + 1))(*pairs, None)
+        fds = (c.c_int32 * 3)()
+        pid = lib().ponyx_os_process_spawn(path.encode(), av, ev, fds)
+        if pid < 0:
+            raise OSError(-pid, os.strerror(-pid))
+        return int(pid), int(fds[0]), int(fds[1]), int(fds[2])
+
+    @staticmethod
+    def check(pid: int):
+        """None while running; exit code 0..255; 256+signum if killed."""
+        r = lib().ponyx_os_process_check(pid)
+        if r == -1:
+            return None
+        if r < -1:
+            raise OSError(-r, os.strerror(-r))
+        return int(r)
+
+    @staticmethod
+    def kill(pid: int, signum: int = 15) -> None:
+        r = lib().ponyx_os_process_kill(pid, signum)
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
 
 
 class HostQueue:

@@ -412,6 +412,14 @@ class Runtime:
             self.net = Net(self)
         return self.net
 
+    def attach_processes(self):
+        """Create (once) the child-process monitor (≙ packages/process
+        over lang/process.c)."""
+        if getattr(self, "procs", None) is None:
+            from ..process import Processes
+            self.procs = Processes(self)
+        return self.procs
+
     @property
     def heap(self):
         """Host object heap for rich message payloads (hostmem.py)."""
@@ -420,6 +428,12 @@ class Runtime:
             from ..hostmem import HostHeap
             h = self._heap = HostHeap()
         return h
+
+    def files_auth(self):
+        """Root file-system capability (≙ env.root AmbientAuth handed to
+        the Main actor; see files.py)."""
+        from ..files import FilesAuth
+        return FilesAuth(FilesAuth._token)
 
     # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
     def _drain_host(self) -> bool:

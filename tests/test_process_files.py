@@ -1,0 +1,135 @@
+"""Child processes and file system (≙ packages/process and
+packages/files integration tests under ponytest)."""
+
+import pytest
+
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.files import Directory, File, FilePath
+
+
+@actor
+class Collector:
+    HOST = True
+    n_out: I32
+    n_err: I32
+    code: I32
+    done: I32
+
+    @behaviour
+    def on_stdout(self, st, proc: I32, data: I32, n: I32):
+        chunk = self.rt.heap.unbox(data)
+        self.rt.heap.box(chunk)  # re-box so the test can inspect later
+        return {**st, "n_out": st["n_out"] + n}
+
+    @behaviour
+    def on_stderr(self, st, proc: I32, data: I32, n: I32):
+        self.rt.heap.drop(data)
+        return {**st, "n_err": st["n_err"] + n}
+
+    @behaviour
+    def on_exit(self, st, proc: I32, code: I32):
+        self.exit(0)
+        return {**st, "code": code, "done": 1}
+
+
+def _mk():
+    rt = Runtime(RuntimeOptions(mailbox_cap=16, batch=4, max_sends=2,
+                                msg_words=4, inject_slots=32))
+    rt.declare(Collector, 1)
+    return rt.start()
+
+
+def test_process_echo_collects_output_and_exit():
+    rt = _mk()
+    procs = rt.attach_processes()
+    owner = rt.spawn(Collector)
+    procs.spawn("/bin/sh", ["sh", "-c", "echo hello-child; exit 7"],
+                owner, on_stdout=Collector.on_stdout,
+                on_stderr=Collector.on_stderr, on_exit=Collector.on_exit)
+    rt.run(max_steps=4000)
+    st = rt.state_of(owner)
+    assert st["done"] == 1
+    assert st["code"] == 7
+    assert st["n_out"] == len(b"hello-child\n")
+    rt.stop()
+
+
+def test_process_stdin_roundtrip_and_stderr():
+    rt = _mk()
+    procs = rt.attach_processes()
+    owner = rt.spawn(Collector)
+    pid = procs.spawn("/bin/sh", ["sh", "-c", "cat; echo oops >&2"],
+                      owner, on_stdout=Collector.on_stdout,
+                      on_stderr=Collector.on_stderr,
+                      on_exit=Collector.on_exit)
+    procs.write(pid, b"pass-through-bytes")
+    procs.close_stdin(pid)
+    rt.run(max_steps=4000)
+    st = rt.state_of(owner)
+    assert st["done"] == 1 and st["code"] == 0
+    assert st["n_out"] == len(b"pass-through-bytes")
+    assert st["n_err"] == len(b"oops\n")
+    rt.stop()
+
+
+def test_process_kill_reports_signal():
+    rt = _mk()
+    procs = rt.attach_processes()
+    owner = rt.spawn(Collector)
+    pid = procs.spawn("/bin/sh", ["sh", "-c", "sleep 30"],
+                      owner, on_stdout=Collector.on_stdout,
+                      on_stderr=Collector.on_stderr,
+                      on_exit=Collector.on_exit)
+    procs.kill(pid, 9)
+    rt.run(max_steps=4000)
+    st = rt.state_of(owner)
+    assert st["code"] == 256 + 9
+    rt.stop()
+
+
+# ---- files (≙ packages/files) ----
+
+def test_filepath_capability_discipline(tmp_path):
+    rt = _mk()
+    root = rt.files_auth()
+    base = FilePath(root, str(tmp_path))
+    sub = base.join("inner/deeper")
+    assert sub.mkdir()
+    assert sub.is_dir()
+    # join cannot escape its parent capability
+    with pytest.raises(PermissionError):
+        base.join("../escape")
+    with pytest.raises(PermissionError):
+        FilePath("not-an-auth", "/etc")     # type: ignore
+    rt.stop()
+
+
+def test_file_write_read_seek(tmp_path):
+    rt = _mk()
+    fp = FilePath(rt.files_auth(), str(tmp_path)).join("log.txt")
+    with File(fp, "w+b") as f:
+        f.print("line one").print("line two").flush()
+        assert f.size() == len(b"line one\nline two\n")
+        f.seek_start(5)
+        assert f.position() == 5
+    with File(fp, "rb") as f:
+        assert f.lines()[:2] == [b"line one", b"line two"]
+    assert fp.is_file() and fp.exists()
+    assert fp.info().st_size == 18
+    rt.stop()
+
+
+def test_directory_walk_and_remove(tmp_path):
+    rt = _mk()
+    base = FilePath(rt.files_auth(), str(tmp_path))
+    d = Directory(base)
+    sub = d.mkdir("pkg")
+    sub.open_file("a.txt").write(b"a").dispose()
+    sub.open_file("b.txt").write(b"b").dispose()
+    assert sub.entries() == ["a.txt", "b.txt"]
+    walked = {fp.path: (dirs, files) for fp, dirs, files in d.walk()}
+    assert base.path in walked and walked[base.path][0] == ["pkg"]
+    assert walked[base.join("pkg").path][1] == ["a.txt", "b.txt"]
+    assert base.join("pkg").remove()
+    assert not base.join("pkg").exists()
+    rt.stop()
