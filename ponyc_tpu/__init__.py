@@ -14,7 +14,8 @@ See SURVEY.md at the repo root for the full mapping to the reference.
 from .api import (Actor, Bool, Context, F32, I32, Ref, actor, be, behaviour)
 from .config import RuntimeOptions, options_from_env, strip_runtime_flags
 from .program import Program
-from .runtime.runtime import Runtime, SpillOverflowError
+from .runtime.runtime import (Runtime, SpawnCapacityError,
+                              SpillOverflowError)
 
 __version__ = "0.1.0"
 
@@ -22,4 +23,5 @@ __all__ = [
     "Actor", "Bool", "Context", "F32", "I32", "Ref", "actor", "be",
     "behaviour", "RuntimeOptions", "options_from_env",
     "strip_runtime_flags", "Program", "Runtime", "SpillOverflowError",
+    "SpawnCapacityError",
 ]

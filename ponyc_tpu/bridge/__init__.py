@@ -92,6 +92,19 @@ class Bridge:
         SIGTERM live-actor dump, analysis.c:55, cycle.c:874-954)."""
         return self.signal(owner, bdef, _signal.SIGTERM)
 
+    def timer_callback(self, fn, interval_s: float, *,
+                       first_s: Optional[float] = None,
+                       oneshot: bool = False, noisy: bool = True) -> int:
+        """Timer whose expiries invoke a host-side callback `fn(event)` at
+        poll boundaries (runtime-internal twin of timer(); the stdlib
+        Timers hub uses it for count-limited timers)."""
+        first = interval_s if first_s is None else first_s
+        sid = self.loop.timer(max(1, int(first * 1e9)),
+                              max(1, int(interval_s * 1e9)),
+                              -1, -1, oneshot=oneshot, noisy=noisy)
+        self._cbs[sid] = fn
+        return sid
+
     def fd_callback(self, fd: int, fn, *, read: bool = True,
                     write: bool = False, noisy: bool = True) -> int:
         """Subscribe an fd whose events are handled by a host-side Python

@@ -14,18 +14,22 @@ within one shard of the actor world:
      send order; SURVEY.md §7 hard part (c)) because a sender whose message
      was rejected is muted until its spill drains, so it can never emit a
      *newer* message that would overtake an older spilled one;
-  3. rank each message within its target segment; accept while
-     rank < free-space (rejections are therefore always the newest suffix
-     per target, keeping FIFO safe);
-  4. one scatter writes all accepted payloads into the mailbox table;
-  5. rejections are stably compacted into the next spill buffer, and their
-     *locally resident* senders muted (≙ ponyint_maybe_mute: mute on
-     sending to an overloaded/muted receiver, actor.c:898-921 — here
-     "receiver rejected or is over the occupancy threshold", the
-     static-shape analog of the reference's batch-exhaustion OVERLOADED
-     flag, actor.c:369-381). Remote senders are not muted by receiver-side
-     rejection yet; their messages still park in this shard's spill, so no
-     ordering guarantee is lost — only the throttling hint is weaker.
+  3. per-target segment bounds come from a vectorised binary search over
+     the sorted keys; each target accepts min(count, free-space), so
+     rejections are always the newest suffix per target, keeping FIFO safe;
+  4. the mailbox table is rebuilt with a *dense gather* over [rows, cap]:
+     ring slot (tail+j)%cap takes sorted entry seg_start+j. TPU-first
+     design note: XLA lowers large scatters to serial loops on TPU, so the
+     one scatter the CPU-obvious design would use here was the whole
+     step's bottleneck — the gather form is fully vectorised (the extra
+     rows×cap reads are cheap next to a serialised 1M-element scatter);
+  5. rejections compact into the next spill buffer and their locally
+     resident senders mute (≙ ponyint_maybe_mute: mute on sending to an
+     overloaded/muted receiver, actor.c:898-921). Both are *pressure
+     paths*: they run under `lax.cond` and cost nothing in the steady
+     state where nothing rejects and nobody is overloaded (≙ the
+     reference only walking mute maps when senders actually muted,
+     scheduler.c:1478-1494).
 """
 
 from __future__ import annotations
@@ -33,9 +37,9 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+from jax import lax
 
-from ..ops.segment import (compact_mask, counts_by_key, segment_ranks,
-                           stable_sort_by)
+from ..ops.segment import compact_mask, stable_sort_by
 
 
 class Entries(NamedTuple):
@@ -64,6 +68,7 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             shard_base) -> DeliveryResult:
     n, c = n_local, mailbox_cap
     tgt, sender, words = entries
+    e = tgt.shape[0]
 
     in_range = (tgt >= 0) & (tgt < n)
     tgt_c = jnp.minimum(jnp.maximum(tgt, 0), n - 1)
@@ -75,57 +80,83 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     key = jnp.where(valid, tgt, n).astype(jnp.int32)
     perm = stable_sort_by(key)
     kt = key[perm]
-    snd = sender[perm]
     wds = words[perm]
-    ok = kt < n
-
-    rank = segment_ranks(kt)
     ktc = jnp.minimum(kt, n - 1)
-    occ = tail - head
-    space = c - occ[ktc]
-    accept = ok & (rank < space)
 
-    slot = (tail[ktc] + rank) % c
-    scatter_row = jnp.where(accept, kt, n)          # row n → dropped
-    buf = buf.at[scatter_row, slot].set(wds, mode="drop")
-    acc_counts = counts_by_key(ktc, accept.astype(jnp.int32), n)
-    new_tail = tail + acc_counts
+    # Per-target segment bounds: one vectorised binary search replaces the
+    # scatter-add histogram (see module docstring, point 4).
+    bounds = jnp.searchsorted(kt, jnp.arange(n + 1, dtype=jnp.int32),
+                              side="left").astype(jnp.int32)
+    seg_start = bounds[:-1]                      # [n]
+    cnt = bounds[1:] - seg_start                 # [n] msgs per target
+    occ = tail - head
+    space = jnp.maximum(c - occ, 0)
+    acc = jnp.minimum(cnt, space)                # accepted per target
+    new_tail = tail + acc
+
+    # Dense ring rebuild: slot (tail+j)%cap ← sorted entry seg_start+j.
+    slots = jnp.arange(c, dtype=jnp.int32)[None, :]
+    rel = (slots - tail[:, None]) % c            # j for each ring slot
+    wmask = rel < acc[:, None]                   # this slot gets a message
+    src = jnp.minimum(seg_start[:, None] + rel, e - 1)
+    buf = jnp.where(wmask[:, :, None], wds[src], buf)
+
+    n_delivered = jnp.sum(acc)
+    nrej = jnp.sum(cnt - acc)
+    n_deadletter = jnp.sum(to_dead.astype(jnp.int32))
     occ_after = new_tail - head
 
-    # Rejections → next spill, stable order (per-target order preserved).
-    rej = ok & ~accept
-    perm2, vspill, nrej = compact_mask(rej, spill_cap)
-    spill = Entries(
-        tgt=jnp.where(vspill, kt[perm2], -1),
-        sender=jnp.where(vspill, snd[perm2], -1),
-        words=jnp.where(vspill[:, None], wds[perm2], 0),
-    )
-    spill_overflow = nrej > spill_cap
+    # --- pressure paths, traced under cond so the quiet steady state
+    # pays nothing (≙ mute bookkeeping only on actual overload).
+    w1 = words.shape[1]
 
-    # Mute triggers (≙ actor.c:898-921 + mute rules actor.c:1171-1235):
-    # a valid send whose receiver rejected it or is now over the overload
-    # threshold mutes the sender — unless the sender is itself overloaded
-    # (the reference's !OVERLOADED/UNDER_PRESSURE guard, which prevents
-    # mute deadlocks among hot actors). Only senders resident on this
-    # shard can be muted here.
-    recv_hot = occ_after[ktc] > overload_occ
-    lsnd = snd - shard_base
-    sender_local = (lsnd >= 0) & (lsnd < n)
-    sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
-    sender_hot = (new_tail[sc] - head[sc]) > overload_occ
-    trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
-    mute_row = jnp.where(trig, sc, n)
-    newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
-        trig, mode="drop")
-    new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
-        jnp.where(trig, kt + shard_base, -1), mode="drop")
+    def pressure(_):
+        rank = jnp.arange(e, dtype=jnp.int32) - seg_start[ktc]
+        ok = kt < n
+        rej = ok & (rank >= acc[ktc])
+        perm2, vspill, _ = compact_mask(rej, spill_cap)
+        snd = sender[perm]
+        spill = Entries(
+            tgt=jnp.where(vspill, kt[perm2], -1),
+            sender=jnp.where(vspill, snd[perm2], -1),
+            words=jnp.where(vspill[:, None], wds[perm2], 0),
+        )
+        # Mute triggers (≙ actor.c:898-921 + mute rules actor.c:1171-1235):
+        # a valid send whose receiver rejected it or is now over the
+        # overload threshold mutes the sender — unless the sender is
+        # itself overloaded (the reference's !OVERLOADED/UNDER_PRESSURE
+        # guard, which prevents mute deadlocks among hot actors). Only
+        # senders resident on this shard can be muted here.
+        recv_hot = occ_after[ktc] > overload_occ
+        lsnd = snd - shard_base
+        sender_local = (lsnd >= 0) & (lsnd < n)
+        sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
+        sender_hot = occ_after[sc] > overload_occ
+        trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
+        mute_row = jnp.where(trig, sc, n)
+        newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
+            trig, mode="drop")
+        new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
+            jnp.where(trig, kt + shard_base, -1), mode="drop")
+        return spill, newly_muted, new_mute_ref
+
+    def quiet(_):
+        return (Entries(tgt=jnp.full((spill_cap,), -1, jnp.int32),
+                        sender=jnp.full((spill_cap,), -1, jnp.int32),
+                        words=jnp.zeros((spill_cap, w1), jnp.int32)),
+                jnp.zeros((n,), jnp.bool_),
+                jnp.full((n,), -1, jnp.int32))
+
+    any_pressure = (nrej > 0) | jnp.any(occ_after > overload_occ)
+    spill, newly_muted, new_mute_ref = lax.cond(
+        any_pressure, pressure, quiet, operand=None)
 
     return DeliveryResult(
         buf=buf, tail=new_tail,
         spill=spill, spill_count=jnp.minimum(nrej, spill_cap),
-        spill_overflow=spill_overflow,
+        spill_overflow=nrej > spill_cap,
         newly_muted=newly_muted, new_mute_ref=new_mute_ref,
-        n_delivered=jnp.sum(accept.astype(jnp.int32)),
+        n_delivered=n_delivered,
         n_rejected=nrej,
-        n_deadletter=jnp.sum(to_dead.astype(jnp.int32)),
+        n_deadletter=n_deadletter,
     )

@@ -1,0 +1,34 @@
+"""Standard-library actor utilities — the TPU framework's counterpart of
+the reference's packages/ tree (SURVEY.md §2.3).
+
+The reference ships ~31 Pony packages. Their capabilities map here as:
+
+  builtin            → the core framework (api/runtime/engine)
+  collections, math,
+  itertools, format  → Python builtins / numpy / jax.numpy (the host
+                       language already provides them; device-side state
+                       is fixed-width columns by design)
+  net                → ponyc_tpu.net (native socket layer underneath)
+  files              → ponyc_tpu.files (capability-checked)
+  process            → ponyc_tpu.process
+  time (Timers)      → stdlib.timers (bridge timerfd underneath)
+  promises           → stdlib.promises
+  random             → stdlib.random (counter-based threefry so vmapped
+                       behaviours draw independent streams — the TPU
+                       idiom replacing packages/random's splittable
+                       xoroshiro)
+  logger             → stdlib.logger (severity-gated, host-side)
+  backpressure       → Runtime mute/unmute machinery (automatic) +
+                       queue_depth introspection
+  serialise          → ponyc_tpu.serialise
+  ponytest           → ponyc_tpu.testing
+  ponybench          → ponyc_tpu.benching
+  signals            → bridge.signal / bridge.sigterm_dump
+  cli/options        → config.strip_runtime_flags + argparse (host)
+  buffered, encode,
+  ini, json, strings → Python stdlib equivalents (host-side text/bytes)
+  bureaucracy        → stdlib.promises.Custodian
+  capsicum           → files.FilesAuth capability chain
+"""
+
+from . import logger, promises, random, timers  # noqa: F401

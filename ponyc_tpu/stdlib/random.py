@@ -1,0 +1,85 @@
+"""Randomness for behaviours — ≙ packages/random.
+
+The reference ships splittable xoroshiro/xorshift generators whose state
+lives in each actor's fields. The TPU idiom is *counter-based* hashing
+(threefry, what jax.random uses): a behaviour derives an independent
+sample from (seed, actor_id, step, draw-index) with pure arithmetic — no
+per-actor generator state to store, no sequential dependence to break
+vectorisation. Device-side helpers are trace-safe and vmap over the
+cohort for free.
+
+    @behaviour
+    def jump(self, st, step: I32):
+        r = random.uniform(self.actor_id, step)        # f32 in [0,1)
+        k = random.randint(self.actor_id, step, 0, 64, draw=1)
+        ...
+
+Host-side, `Rand` mirrors the reference's object API (next/int/real)
+for driver code and tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_DEFAULT_SEED = 0x5DEECE66
+
+
+def _mix(a, b):
+    """One 64→32 threefry-ish mixing round pair on i32 lanes (cheap,
+    statistically fine for actor workloads; swap for jax.random in
+    cryptographic contexts)."""
+    a = jnp.asarray(a, jnp.uint32)
+    b = jnp.asarray(b, jnp.uint32)
+    x = a * jnp.uint32(0x9E3779B9) + b
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def bits(actor_id, step, draw: int = 0, seed: int = _DEFAULT_SEED):
+    """32 uniform bits per (actor, step, draw) — the counter-based core."""
+    h = _mix(jnp.asarray(seed, jnp.uint32), actor_id)
+    h = _mix(h, step)
+    return _mix(h, jnp.asarray(draw, jnp.uint32))
+
+
+def uniform(actor_id, step, draw: int = 0, seed: int = _DEFAULT_SEED):
+    """f32 in [0, 1) (≙ Random.real)."""
+    return (bits(actor_id, step, draw, seed) >> 8).astype(
+        jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def randint(actor_id, step, lo, hi, draw: int = 0,
+            seed: int = _DEFAULT_SEED):
+    """i32 in [lo, hi) (≙ Random.int)."""
+    span = jnp.asarray(hi - lo, jnp.uint32)
+    return (jnp.asarray(lo, jnp.int32)
+            + (bits(actor_id, step, draw, seed) % span).astype(jnp.int32))
+
+
+class Rand:
+    """Sequential host-side generator with the reference's object API
+    (packages/random/random.pony: next/int/real/shuffle)."""
+
+    def __init__(self, seed: int = _DEFAULT_SEED):
+        self._s = seed & 0xFFFFFFFF
+        self._i = 0
+
+    def next(self) -> int:
+        self._i += 1
+        x = (self._s + self._i * 0x9E3779B9) & 0xFFFFFFFF
+        x = ((x ^ (x >> 16)) * 0x85EBCA6B) & 0xFFFFFFFF
+        x = ((x ^ (x >> 13)) * 0xC2B2AE35) & 0xFFFFFFFF
+        return x ^ (x >> 16)
+
+    def int(self, n: int) -> int:
+        return self.next() % n
+
+    def real(self) -> float:
+        return (self.next() >> 8) / float(1 << 24)
+
+    def shuffle(self, xs) -> None:
+        for i in range(len(xs) - 1, 0, -1):
+            j = self.int(i + 1)
+            xs[i], xs[j] = xs[j], xs[i]

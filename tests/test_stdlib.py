@@ -1,0 +1,186 @@
+"""stdlib utilities (≙ each package's _test.pony: promises, time,
+random, logger)."""
+
+import io
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.stdlib import logger as L
+from ponyc_tpu.stdlib import random as R
+from ponyc_tpu.stdlib.promises import (Custodian, Promise, PromiseRejected,
+                                       join, select)
+from ponyc_tpu.stdlib.timers import Timers
+
+
+# ---- promises (≙ packages/promises/_test.pony) ----
+
+def test_promise_fulfil_and_chain():
+    p = Promise()
+    seen = []
+    p.next(lambda v: v * 2).next(seen.append)
+    p.fulfil(21)
+    assert seen == [42]
+    assert p.value() == 21
+    p.fulfil(99)                      # write-once
+    assert p.value() == 21
+
+
+def test_promise_reject_propagates():
+    p = Promise()
+    errs = []
+    p.next(lambda v: v, rejected=errs.append)
+    p.reject("nope")
+    assert errs == ["nope"]
+    with pytest.raises(PromiseRejected):
+        p.value()
+
+
+def test_promise_chain_after_resolution():
+    p = Promise().fulfil(5)
+    got = []
+    p.next(got.append)
+    assert got == [5]
+
+
+def test_join_and_select():
+    ps = [Promise() for _ in range(3)]
+    j = join(ps)
+    s = select([Promise(), Promise()])
+    for i, p in enumerate(ps):
+        p.fulfil(i)
+    assert j.value() == [0, 1, 2]
+    s_src = select([Promise().fulfil("first"), Promise()])
+    assert s_src.value() == "first"
+    assert not s.done()
+
+
+def test_promise_fulfilled_by_actor_program():
+    @actor
+    class Summer:
+        HOST = True
+        total: I32
+
+        @behaviour
+        def add(self, st, x: I32):
+            t = st["total"] + x
+            if t >= 6:
+                self.rt._test_promise.fulfil(t)
+            return {**st, "total": t}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=4, max_sends=1,
+                                msg_words=2, inject_slots=16))
+    rt.declare(Summer, 1).start()
+    a = rt.spawn(Summer)
+    p = Promise(rt)
+    rt._test_promise = p
+    for x in (1, 2, 3):
+        rt.send(a, Summer.add, x)
+    assert p.value(timeout=30) == 6
+
+
+def test_custodian_disposes_everything():
+    class D:
+        def __init__(self):
+            self.closed = False
+
+        def dispose(self):
+            self.closed = True
+
+    c = Custodian()
+    ds = [D(), D()]
+    for d in ds:
+        c.apply(d)
+    c.dispose()
+    assert all(d.closed for d in ds)
+
+
+# ---- random (≙ packages/random/_test.pony) ----
+
+def test_device_random_is_deterministic_and_spread():
+    ids = jnp.arange(1024, dtype=jnp.int32)
+    u1 = jax.vmap(lambda a: R.uniform(a, 7))(ids)
+    u2 = jax.vmap(lambda a: R.uniform(a, 7))(ids)
+    assert np.allclose(u1, u2)               # counter-based: reproducible
+    u3 = jax.vmap(lambda a: R.uniform(a, 8))(ids)
+    assert not np.allclose(u1, u3)           # new step → new draws
+    arr = np.asarray(u1)
+    assert 0.0 <= arr.min() and arr.max() < 1.0
+    assert 0.4 < arr.mean() < 0.6            # roughly uniform
+    k = np.asarray(jax.vmap(lambda a: R.randint(a, 3, 10, 20))(ids))
+    assert k.min() >= 10 and k.max() < 20 and len(np.unique(k)) == 10
+
+
+def test_host_rand_api():
+    r = R.Rand(seed=123)
+    xs = [r.int(100) for _ in range(50)]
+    assert all(0 <= x < 100 for x in xs)
+    assert len(set(xs)) > 20
+    assert 0.0 <= r.real() < 1.0
+    lst = list(range(10))
+    R.Rand(seed=5).shuffle(lst)
+    assert sorted(lst) == list(range(10)) and lst != list(range(10))
+
+
+# ---- timers (≙ packages/time/_test.pony) ----
+
+@actor
+class Ticker:
+    HOST = True
+    ticks: I32
+
+    @behaviour
+    def tick(self, st, kind: I32, arg: I32, flags: I32):
+        t = st["ticks"] + arg
+        self.exit(0, when=t >= 3)
+        return {**st, "ticks": t}
+
+
+def test_count_limited_timer_stops_itself():
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=4, max_sends=1,
+                                msg_words=3, inject_slots=16))
+    rt.declare(Ticker, 1).start()
+    a = rt.spawn(Ticker)
+    timers = Timers(rt)
+    timers.timer(a, Ticker.tick, 0.01, count=3)
+    code = rt.run(max_steps=20000)
+    assert code == 0
+    assert rt.state_of(a)["ticks"] == 3
+    time.sleep(0.05)                  # were it still live, more would queue
+    assert not timers._live
+    timers.dispose()
+    rt.stop()
+
+
+def test_after_fires_once():
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=4, max_sends=1,
+                                msg_words=3, inject_slots=16))
+    rt.declare(Ticker, 1).start()
+    a = rt.spawn(Ticker)
+    timers = Timers(rt)
+    t0 = time.time()
+    timers.after(a, Ticker.tick, 0.05)
+    rt.run(max_steps=20000)
+    assert rt.state_of(a)["ticks"] == 1
+    assert time.time() - t0 >= 0.04
+    timers.dispose()
+    rt.stop()
+
+
+# ---- logger (≙ packages/logger/_test.pony) ----
+
+def test_logger_gating_and_sink():
+    out = io.StringIO()
+    log = L.Logger(L.WARN, out=out)
+    assert not log(L.INFO)
+    assert log(L.ERROR)
+    assert not log.info("hidden")
+    assert log.warn("visible")
+    assert log.error("bad")
+    text = out.getvalue()
+    assert "hidden" not in text
+    assert "WARN" in text and "visible" in text and "bad" in text
