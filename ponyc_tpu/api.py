@@ -156,7 +156,8 @@ class Context:
 
     __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
-                 "spawn_claims", "destroy_called")
+                 "spawn_claims", "destroy_called", "error_flag",
+                 "error_code", "error_called")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None):
         self.actor_id = actor_id          # traced i32 scalar (global id)
@@ -168,6 +169,9 @@ class Context:
         self.destroy_flag = jnp.bool_(False)
         self.spawn_fail = jnp.bool_(False)
         self.destroy_called = False      # trace-time: did destroy() run?
+        self.error_flag = jnp.bool_(False)
+        self.error_code = jnp.int32(0)
+        self.error_called = False        # trace-time: did error_int() run?
         # {target type name: [n_sites] i32 reserved global ids} for this
         # dispatch; None entries = -1 (no free slot was available).
         self._spawn_resv = spawn_resv or {}
@@ -250,3 +254,16 @@ class Context:
         """Stop draining this actor's mailbox for the rest of the step
         (≙ the fork's ponyint_actor_yield, actor.c:675-679)."""
         self.yield_flag = self.yield_flag | jnp.asarray(when, jnp.bool_)
+
+    def error_int(self, code, when=True):
+        """Record an int-coded error on this actor (≙ the fork's
+        pony_error_int / pony_error_code, pony.h:622-665 — errors are
+        *values*, not unwinding). The actor keeps running (a Pony
+        behaviour must handle its own errors; the code here is the
+        observable residue): the latest nonzero code is queryable via
+        Runtime.last_error() and surfaces in the analysis dump."""
+        self.error_called = True
+        w = jnp.asarray(when, jnp.bool_)
+        self.error_flag = self.error_flag | w
+        self.error_code = jnp.where(w, jnp.asarray(code, jnp.int32),
+                                    self.error_code)

@@ -70,6 +70,9 @@ class RuntimeOptions:
     # --- analysis / telemetry (≙ --ponyanalysis, analysis.c) ---
     analysis: int = 0              # 0 off, 1 summary, 2 full event CSV
     analysis_path: str = "/tmp/pony_tpu.analytics.csv"
+    debug_checks: bool = False     # run Runtime.check_invariants() at
+    #   every aux fetch (≙ the reference's debug-build queue checkers,
+    #   actor.c:57-92; costly — test/debug only)
 
     # --- sharding (≙ the scale axis the reference lacks; SURVEY §2.4) ---
     mesh_shards: int = 1           # actor-axis shards (1 = single chip)

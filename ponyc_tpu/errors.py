@@ -1,0 +1,47 @@
+"""Int-coded errors — ≙ the fork's error machinery
+(pony.h:610-665 pony_try/pony_error/pony_error_int/pony_error_code/
+pony_error_loc; lang/posix_except.c + except_try_catch.ll underneath).
+
+The fork replaced Pony's bare `error` with errors that carry an int
+code and a source location, caught by `try ... else` and queryable via
+`__error_code()`. The TPU framework's three surfaces:
+
+- **Host behaviours** raise PonyError(code): the dispatch loop catches
+  it, records the code, and the actor continues with its next message —
+  exactly a behaviour-local `try ... else` that logs (a Pony behaviour
+  cannot leak errors; the unwind stops at the dispatch boundary).
+- **Host driver code** uses pony_try() to get the (ok, value_or_code)
+  shape of the reference's pony_try (pony.h:610).
+- **Device behaviours** call ctx.error_int(code, when=...) — errors are
+  values under vmap; the latest code lands in the per-actor
+  `last_error` column and the n_errors counter (api.py).
+
+Locations: PonyError captures the raise site (≙ pony_error_loc's
+file/line), surfaced in logs and pony_try results.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable, Tuple
+
+
+class PonyError(Exception):
+    """≙ pony_error_int: an error that is a value with an int code."""
+
+    def __init__(self, code: int = 1, message: str = ""):
+        super().__init__(message or f"error {code}")
+        self.code = int(code)
+        # ≙ pony_error_loc: the raise site.
+        stack = traceback.extract_stack(limit=3)
+        frame = stack[0] if stack else None
+        self.loc = (f"{frame.filename}:{frame.lineno}" if frame else "?")
+
+
+def pony_try(fn: Callable, *args, **kw) -> Tuple[bool, Any]:
+    """≙ pony_try (pony.h:610): run fn; (True, result) on success,
+    (False, error_code) when it raises PonyError."""
+    try:
+        return True, fn(*args, **kw)
+    except PonyError as e:
+        return False, e.code

@@ -96,6 +96,7 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
         args = pack.unpack_args(bdef.arg_specs, payload)
         st2 = bdef.fn(ctx, dict(st), *args)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
+        effects["error"] = effects["error"] or ctx.error_called
         if st2 is None:
             raise TypeError(
                 f"behaviour {bdef} must return the (possibly updated) state "
@@ -128,7 +129,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                           else jnp.zeros((0,), jnp.int32))
         return (st2, (tgt_arr, words_arr),
                 (ctx.exit_flag, ctx.exit_code), ctx.yield_flag,
-                tuple(claims), ctx.spawn_fail, ctx.destroy_flag)
+                tuple(claims), ctx.spawn_fail, ctx.destroy_flag,
+                (ctx.error_flag, ctx.error_code))
 
     return branch
 
@@ -145,7 +147,8 @@ def _make_noop_branch(msg_words: int, max_sends: int, spawn_sites):
                 jnp.bool_(False),
                 tuple(jnp.full((n,), -1, jnp.int32)
                       for _, n in spawn_sites),
-                jnp.bool_(False), jnp.bool_(False))
+                jnp.bool_(False), jnp.bool_(False),
+                (jnp.bool_(False), jnp.int32(0)))
 
     return branch
 
@@ -164,7 +167,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         field_dtypes[fname] = (jnp.float32 if spec is pack.F32
                                else jnp.int32)
     spawn_sites = tuple(sorted(cohort.spawns.items()))
-    effects = {"destroy": False}
+    effects = {"destroy": False, "error": False}
     branches = [_make_branch(b, msg_words, ms, field_dtypes, spawn_sites,
                              effects)
                 for b in cohort.behaviours]
@@ -176,34 +179,36 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         # msgs: [batch, 1+W]; valids: [batch] bool;
         # resv: {target: [batch, sites]} reserved refs per dispatch slot.
         def scan_body(carry, x):
-            st, stopped, ef, ec, sfail, dstr, nproc, nbad = carry
+            (st, stopped, ef, ec, sfail, dstr, errf, errc, nproc,
+             nbad) = carry
             msg, valid, resv_k = x
             local = msg[0] - base
             in_range = (local >= 0) & (local < nb)
             do = valid & ~stopped
             bid = jnp.where(do & in_range, local, nb)
-            (st2, (stgt, swords), (bef, bec), yf, claims, bsf,
-             bdstr) = lax.switch(bid, branches, (st, msg[1:], actor_id,
-                                                 resv_k))
+            (st2, (stgt, swords), (bef, bec), yf, claims, bsf, bdstr,
+             (bErrF, bErrC)) = lax.switch(bid, branches,
+                                          (st, msg[1:], actor_id, resv_k))
             new_ef = ef | bef
             new_ec = jnp.where(bef & ~ef, bec, ec)
             stopped2 = stopped if noyield else (stopped | yf)
             return ((st2, stopped2, new_ef, new_ec, sfail | bsf,
-                     dstr | bdstr,
+                     dstr | bdstr, errf | bErrF,
+                     jnp.where(bErrF, bErrC, errc),
                      nproc + (do & in_range).astype(jnp.int32),
                      nbad + (do & ~in_range).astype(jnp.int32)),
                     (stgt, swords, do, claims))
 
         carry0 = (st_row, jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
-                  jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
-                  jnp.int32(0))
+                  jnp.bool_(False), jnp.bool_(False), jnp.bool_(False),
+                  jnp.int32(0), jnp.int32(0), jnp.int32(0))
         resv_xs = tuple(resv[t] for t, _ in spawn_sites)
-        ((stf, _, ef, ec, sfail, dstr, nproc, nbad),
+        ((stf, _, ef, ec, sfail, dstr, errf, errc, nproc, nbad),
          (stgt, swords, consumed, claims)) = lax.scan(
             scan_body, carry0, (msgs, valids, resv_xs))
         n_consumed = jnp.sum(consumed.astype(jnp.int32))
-        return (stf, (stgt, swords), ef, ec, sfail, dstr, nproc, nbad,
-                n_consumed, claims)
+        return (stf, (stgt, swords), ef, ec, sfail, dstr, (errf, errc),
+                nproc, nbad, n_consumed, claims)
 
     vfn = jax.vmap(actor_fn)
 
@@ -215,8 +220,9 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
         msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
         valids = k[None, :] < n_run[:, None]
-        (stf, (stgt, swords), ef, ec, sfail, dstr, nproc, nbad, n_consumed,
-         claims) = vfn(type_state_rows, msgs, valids, ids, resv)
+        (stf, (stgt, swords), ef, ec, sfail, dstr, errs, nproc, nbad,
+         n_consumed, claims) = vfn(type_state_rows, msgs, valids, ids,
+                                   resv)
         # Flatten the outbox: (actor, slot, send) order — exactly a
         # sender's causal emission order.
         e = cohort.local_capacity * batch * ms
@@ -231,7 +237,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                        for (t, _), c in zip(spawn_sites, claims)}
         return (stf, out, head_rows + n_consumed, any_exit, code,
                 jnp.sum(nproc), jnp.sum(nbad), flat_claims,
-                jnp.any(sfail), dstr if effects["destroy"] else None)
+                jnp.any(sfail), dstr if effects["destroy"] else None,
+                errs if effects["error"] else None)
 
     return run_cohort
 
@@ -399,6 +406,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         claim_lists: Dict[str, List[jnp.ndarray]] = {
             t: [] for t in program.spawn_target_names}
         destroy_rows: List[Tuple[int, jnp.ndarray]] = []  # (s0, [rows] bool)
+        error_rows: List[Tuple[int, Any]] = []   # (s0, ([rows] bool, codes))
         exit_f = st.exit_flag[0]
         exit_c = st.exit_code[0]
         spawn_fail = st.spawn_fail[0]
@@ -408,7 +416,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             s0, s1 = ch.local_start, ch.local_stop
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
             (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, sfail,
-             dstr) = run_cohort(
+             dstr, errs) = run_cohort(
                 st.type_state[ch.atype.__name__],
                 st.buf[s0:s1], st.head[s0:s1], occ0[s0:s1],
                 runnable[s0:s1], ids, cohort_resv(ch))
@@ -420,6 +428,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             if ch.spawns:
                 spawn_fail = spawn_fail | sfail
             destroy_rows.append((s0, dstr))
+            error_rows.append((s0, errs))
             exit_c = jnp.where(ef & ~exit_f, ec, exit_c)
             exit_f = exit_f | ef
             nproc_total = nproc_total + nproc
@@ -509,6 +518,20 @@ def build_step(program: Program, opts: RuntimeOptions):
         # clear, and the row becomes reclaimable by a later spawn.
         new_tail = res.tail
         pinned = st.pinned
+        # Int-coded error residue (≙ pony_error_int/code, fork): latest
+        # nonzero code per actor + a counter; zero-cost for cohorts whose
+        # behaviours never call ctx.error_int (gated at trace).
+        last_error = st.last_error
+        n_errors = jnp.int32(0)
+        for s0, errs in error_rows:
+            if errs is None:
+                continue
+            errf, errc = errs
+            rows = jnp.where(errf, s0 + jnp.arange(errf.shape[0],
+                                                   dtype=jnp.int32), nl)
+            last_error = last_error.at[rows].set(
+                jnp.where(errf, errc, 0), mode="drop")
+            n_errors = n_errors + jnp.sum(errf.astype(jnp.int32))
         n_destroyed = jnp.int32(0)
         for s0, dstr in destroy_rows:
             if dstr is None:
@@ -610,6 +633,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_destroyed=vec(st.n_destroyed[0] + n_destroyed),
             spawn_fail=vec(spawn_fail, jnp.bool_),
             n_collected=st.n_collected,
+            last_error=last_error,
+            n_errors=vec(st.n_errors[0] + n_errors),
             type_state=new_type_state,
         )
         aux = StepAux(

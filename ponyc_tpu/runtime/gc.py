@@ -205,6 +205,8 @@ def build_gc(program: Program, opts: RuntimeOptions):
             n_spawned=st.n_spawned, n_destroyed=st.n_destroyed,
             spawn_fail=st.spawn_fail,
             n_collected=st.n_collected + n_dead.reshape(1),
+            last_error=jnp.where(dead, 0, st.last_error),
+            n_errors=st.n_errors,
             type_state=st.type_state,
         )
         if p > 1:

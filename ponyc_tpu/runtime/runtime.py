@@ -28,6 +28,7 @@ import numpy as np
 
 from ..api import ActorTypeMeta, BehaviourDef
 from ..config import RuntimeOptions
+from ..errors import PonyError
 from ..ops import pack
 from ..program import Program
 from . import engine
@@ -129,6 +130,7 @@ class Runtime:
         self._ref_mask = None
         self._ever_released = False
         self._last_gc_step = 0
+        self._host_errors: Dict[int, int] = {}
 
     # ---- construction (≙ pony_init) ----
     def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
@@ -467,7 +469,14 @@ class Runtime:
                 ctx = HostContext(self, aid)
                 st = self._host_state.get(aid, {})
                 args = _host_unpack_args(bdef.arg_specs, msg[1:])
-                st2 = bdef.fn(ctx, st, *args)
+                try:
+                    st2 = bdef.fn(ctx, st, *args)
+                except PonyError as e:
+                    # ≙ a behaviour-local `try...else` (fork int-coded
+                    # errors): record the code, actor continues.
+                    self._host_errors[aid] = e.code
+                    self.totals["host_errors"] += 1
+                    st2 = st
                 self._host_state[aid] = st2 if st2 is not None else st
                 self.totals["host_processed"] += 1
                 if ctx.exit_flag:
@@ -501,6 +510,8 @@ class Runtime:
                 self.steps_run += 1
                 steps_this_run += 1
             a = jax.device_get(aux)
+            if self.opts.debug_checks:
+                self.check_invariants()
             # aux counters are cumulative int32; accumulate mod-2^32 deltas
             # so fetch cadence doesn't matter (< 2^31 events per window).
             for key, cur in (("processed", int(a.n_processed) & 0xFFFFFFFF),
@@ -575,6 +586,36 @@ class Runtime:
     # the analysis dump hooks, analysis.c) ----
     def queue_depth(self, actor_id: int) -> int:
         return int(self.state.tail[actor_id] - self.state.head[actor_id])
+
+    def last_error(self, actor_id: int) -> int:
+        """Latest int-coded error on an actor, 0 = none (≙ the fork's
+        __error_code(); device via ctx.error_int, host via PonyError)."""
+        if self.program.cohort_of(actor_id).host:
+            return self._host_errors.get(int(actor_id), 0)
+        return int(self.state.last_error[actor_id])
+
+    def check_invariants(self) -> None:
+        """Debug-build queue/flag invariants (≙ well_formed_msg_chain +
+        messageq_size_debug, actor.c:57-92 / messageq.c:15-27 — the
+        reference compiles these in for debug builds; call this from
+        tests or enable opts.debug_checks to run it at every aux fetch).
+        Raises AssertionError with the first violated invariant."""
+        st = jax.device_get(self.state)
+        occ = st.tail - st.head
+        c = self.opts.mailbox_cap
+        assert (occ >= 0).all(), "mailbox occupancy negative (head>tail)"
+        assert (occ <= c).all(), "mailbox occupancy exceeds capacity"
+        alive = np.asarray(st.alive)
+        muted = np.asarray(st.muted)
+        assert not (muted & ~alive).any(), "dead actor still muted"
+        assert (np.asarray(st.mute_ref)[~muted] == -1).all(), \
+            "unmuted actor holds a mute ref"
+        dead_occ = occ[~alive]
+        assert (dead_occ == 0).all(), "dead actor with queued messages"
+        for name in ("dspill", "rspill"):
+            tgts = np.asarray(getattr(st, name + "_tgt"))
+            cnt = int(np.asarray(getattr(st, name + "_count")).sum())
+            assert cnt <= tgts.shape[0], f"{name} count exceeds capacity"
 
     def counter(self, name: str) -> int:
         """Sum a per-shard runtime counter (n_processed, n_delivered,

@@ -92,6 +92,9 @@ class RtState:
     n_destroyed: jnp.ndarray  # [P] int32 — ctx.destroy() completions
     spawn_fail: jnp.ndarray   # [P] bool — sticky: a wanted spawn had no slot
     n_collected: jnp.ndarray  # [P] int32 — actors freed by GC (gc.py)
+    last_error: jnp.ndarray   # [N] int32 — latest ctx.error_int code
+    #                              (0 = none; ≙ fork's pony_error_code)
+    n_errors: jnp.ndarray     # [P] int32 — error_int events
 
     # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
     # (leading axis shard-major; see Cohort.slot_to_col).
@@ -150,5 +153,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_destroyed=jnp.zeros((p,), i32),
         spawn_fail=jnp.zeros((p,), jnp.bool_),
         n_collected=jnp.zeros((p,), i32),
+        last_error=jnp.zeros((n,), i32),
+        n_errors=jnp.zeros((p,), i32),
         type_state=type_state,
     )

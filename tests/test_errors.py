@@ -1,0 +1,111 @@
+"""Int-coded errors + debug invariants (≙ the fork's pony_error_int/
+pony_error_code machinery, test/libponyrt/lang/error.cc, and the
+debug-build queue checkers actor.c:57-92)."""
+
+import pytest
+
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.errors import PonyError, pony_try
+
+
+@actor
+class Div:
+    ok: I32
+
+    @behaviour
+    def div(self, st, a: I32, b: I32):
+        # Errors are values under vmap: record the code, skip the work.
+        bad = b == 0
+        self.error_int(7, when=bad)
+        import jax.numpy as jnp
+        q = a // jnp.where(bad, 1, b)
+        return {**st, "ok": jnp.where(bad, st["ok"], q)}
+
+
+@actor
+class HostDiv:
+    HOST = True
+    ok: I32
+
+    @behaviour
+    def div(self, st, a: I32, b: I32):
+        if b == 0:
+            raise PonyError(9, "divide by zero")
+        return {**st, "ok": a // b}
+
+
+def _mk():
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, max_sends=1,
+                                msg_words=2, inject_slots=16,
+                                debug_checks=True))
+    rt.declare(Div, 4).declare(HostDiv, 2)
+    return rt.start()
+
+
+def test_device_error_int_records_and_continues():
+    rt = _mk()
+    a = rt.spawn(Div)
+    rt.send(a, Div.div, 10, 2)
+    rt.send(a, Div.div, 10, 0)     # errors with code 7
+    rt.send(a, Div.div, 9, 3)      # still alive, keeps dispatching
+    rt.run(max_steps=20)
+    assert rt.state_of(a)["ok"] == 3
+    assert rt.last_error(a) == 7
+    assert rt.counter("n_errors") == 1
+    b = rt.spawn(Div)
+    assert rt.last_error(b) == 0
+
+
+def test_host_pony_error_is_caught_per_behaviour():
+    rt = _mk()
+    h = rt.spawn(HostDiv)
+    rt.send(h, HostDiv.div, 12, 3)
+    rt.send(h, HostDiv.div, 12, 0)   # raises PonyError(9) — swallowed
+    rt.send(h, HostDiv.div, 20, 5)   # actor continues
+    rt.run(max_steps=20)
+    assert rt.state_of(h)["ok"] == 4
+    assert rt.last_error(h) == 9
+    assert rt.totals["host_errors"] == 1
+
+
+def test_pony_try_shape():
+    ok, v = pony_try(lambda: 42)
+    assert ok and v == 42
+    ok, code = pony_try(lambda: (_ for _ in ()).throw(PonyError(5)))
+    assert not ok and code == 5
+    e = PonyError(3, "msg")
+    assert e.code == 3 and ":" in e.loc   # carries a raise location
+    with pytest.raises(ValueError):
+        pony_try(lambda: (_ for _ in ()).throw(ValueError()))  # not caught
+
+
+def test_invariants_hold_through_pressure():
+    # Overflow a mailbox so spill/mute machinery engages, with
+    # debug_checks validating every aux fetch along the way.
+    from ponyc_tpu import Ref
+
+    @actor
+    class Flood:
+        sink: Ref
+
+        @behaviour
+        def go(self, st, n: I32):
+            self.send(st["sink"], Flood.rx, n)
+            return st
+
+        @behaviour
+        def rx(self, st, n: I32):
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=1, max_sends=1,
+                                msg_words=2, inject_slots=64, spill_cap=64,
+                                debug_checks=True))
+    rt.declare(Flood, 16).start()
+    ids = rt.spawn_many(Flood, 16)
+    rt.set_fields(Flood, ids, sink=int(ids[0]))
+    for i in ids[1:]:
+        for k in range(3):
+            rt.send(int(i), Flood.go, k)
+    rt.run(max_steps=200)
+    rt.check_invariants()
+    assert rt.counter("n_delivered") > 0
