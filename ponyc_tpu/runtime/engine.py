@@ -42,8 +42,7 @@ from jax import lax
 from ..api import Context
 from ..config import RuntimeOptions
 from ..ops import pack
-from ..ops.segment import (compact_mask, counts_by_key, segment_ranks,
-                           stable_sort_by)
+from ..ops.segment import compact_mask, counts_by_key, stable_sort_by
 from ..program import Cohort, Program
 from .delivery import Entries, deliver
 from .state import RtState
@@ -261,19 +260,24 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     dest = jnp.where(valid, tgt // n_local, shards).astype(jnp.int32)
     perm = stable_sort_by(dest)
     dt = dest[perm]
-    ok = dt < shards
-    rank = segment_ranks(dt)
-    accept = ok & (rank < bucket)
-
-    dtc = jnp.minimum(dt, shards - 1)
-    slot = dtc * bucket + rank
-    slot = jnp.where(accept, slot, shards * bucket)  # OOB → dropped
-    bt = jnp.full((shards * bucket,), -1, jnp.int32).at[slot].set(
-        tgt[perm], mode="drop")
-    bs = jnp.full((shards * bucket,), -1, jnp.int32).at[slot].set(
-        sender[perm], mode="drop")
-    bw = jnp.zeros((shards * bucket, words.shape[1]), jnp.int32).at[
-        slot].set(words[perm], mode="drop")
+    ts = tgt[perm]
+    ss = sender[perm]
+    ws = words[perm]
+    # Per-destination segment bounds via binary search; the bucket table
+    # is then a dense gather [shards, bucket] from the sorted entries —
+    # same scatter-free design as delivery.py (TPU scatters serialise).
+    bounds = jnp.searchsorted(dt, jnp.arange(shards + 1, dtype=jnp.int32),
+                              side="left").astype(jnp.int32)
+    seg_start = bounds[:-1]
+    cnt = bounds[1:] - seg_start                     # [shards]
+    acc = jnp.minimum(cnt, bucket)
+    j = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+    fill = j < acc[:, None]                          # [shards, bucket]
+    src = jnp.minimum(seg_start[:, None] + j, e - 1)
+    bt = jnp.where(fill, ts[src], -1).reshape(shards * bucket)
+    bs = jnp.where(fill, ss[src], -1).reshape(shards * bucket)
+    bw = jnp.where(fill[:, :, None], ws[src], 0).reshape(
+        shards * bucket, -1)
 
     rt = lax.all_to_all(bt, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
@@ -282,25 +286,42 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     rw = lax.all_to_all(bw, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
 
-    # Bucket overflow → route spill (stays on this shard, ordered).
-    rej = ok & ~accept
-    perm2, vsp, nrej = compact_mask(rej, rspill_cap)
-    new_rspill = Entries(
-        tgt=jnp.where(vsp, tgt[perm][perm2], -1),
-        sender=jnp.where(vsp, sender[perm][perm2], -1),
-        words=jnp.where(vsp[:, None], words[perm][perm2], 0),
-    )
-    # Mute the (always local) senders of parked messages; ref = target.
-    lsnd = sender[perm] - shard_base
-    s_ok = rej & (lsnd >= 0) & (lsnd < n_local)
-    sc = jnp.minimum(jnp.maximum(lsnd, 0), n_local - 1)
-    s_hot = (tail[sc] - head[sc]) > overload_occ
-    trig = s_ok & ~s_hot
-    mute_row = jnp.where(trig, sc, n_local)
-    newly_muted = jnp.zeros((n_local,), jnp.bool_).at[mute_row].max(
-        trig, mode="drop")
-    new_ref = jnp.full((n_local,), -1, jnp.int32).at[mute_row].max(
-        jnp.where(trig, tgt[perm], -1), mode="drop")
+    nrej = jnp.sum(cnt - acc)
+    w1 = words.shape[1]
+
+    def pressure(_):
+        # Bucket overflow → route spill (stays on this shard, ordered)
+        # + mute the (always local) senders of parked messages.
+        rank = jnp.arange(e, dtype=jnp.int32) - seg_start[
+            jnp.minimum(dt, shards - 1)]
+        rej = (dt < shards) & (rank >= bucket)
+        perm2, vsp, _ = compact_mask(rej, rspill_cap)
+        spill = Entries(
+            tgt=jnp.where(vsp, ts[perm2], -1),
+            sender=jnp.where(vsp, ss[perm2], -1),
+            words=jnp.where(vsp[:, None], ws[perm2], 0),
+        )
+        lsnd = ss - shard_base
+        s_ok = rej & (lsnd >= 0) & (lsnd < n_local)
+        sc = jnp.minimum(jnp.maximum(lsnd, 0), n_local - 1)
+        s_hot = (tail[sc] - head[sc]) > overload_occ
+        trig = s_ok & ~s_hot
+        mute_row = jnp.where(trig, sc, n_local)
+        newly_muted = jnp.zeros((n_local,), jnp.bool_).at[mute_row].max(
+            trig, mode="drop")
+        new_ref = jnp.full((n_local,), -1, jnp.int32).at[mute_row].max(
+            jnp.where(trig, ts, -1), mode="drop")
+        return spill, newly_muted, new_ref
+
+    def quiet(_):
+        return (Entries(tgt=jnp.full((rspill_cap,), -1, jnp.int32),
+                        sender=jnp.full((rspill_cap,), -1, jnp.int32),
+                        words=jnp.zeros((rspill_cap, w1), jnp.int32)),
+                jnp.zeros((n_local,), jnp.bool_),
+                jnp.full((n_local,), -1, jnp.int32))
+
+    new_rspill, newly_muted, new_ref = lax.cond(
+        nrej > 0, pressure, quiet, operand=None)
 
     received = Entries(tgt=rt, sender=rs, words=rw)
     return (received, new_rspill, jnp.minimum(nrej, rspill_cap),
