@@ -123,6 +123,11 @@ class ActorTypeMeta(type):
         # {TargetType_or_name: max ctx.spawn() sites per dispatch}. Spawning
         # is opt-in because reservations cost free-slot compaction per step.
         cls.SPAWNS = ns.get("SPAWNS", {})
+        # How many of an actor's ≤batch dispatches per step may spawn
+        # (default: all of them). Lowering it shrinks the free-slot window
+        # each runnable actor reserves; a step that exceeds it raises
+        # SpawnCapacityError (safe, no corruption).
+        cls.SPAWN_DISPATCHES = ns.get("SPAWN_DISPATCHES", None)
         return cls
 
     @property

@@ -2,4 +2,5 @@
 never had (SURVEY.md §2.4) — actor rows shard over an 'actors' mesh axis,
 messages route via all_to_all collectives over ICI/DCN."""
 
+from . import distributed  # noqa: F401
 from .mesh import make_mesh, shard_state  # noqa: F401

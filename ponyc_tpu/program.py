@@ -57,6 +57,8 @@ class Cohort:
         self.spawns: Dict[str, int] = {}     # target type name → sites/dispatch
         self.spawn_offsets: Dict[str, int] = {}  # target name → offset into
         #   the target cohort's compacted free-row list (static partition)
+        sd = getattr(atype, "SPAWN_DISPATCHES", None)
+        self.spawn_dispatches = min(self.batch, sd) if sd else self.batch
 
     def slot_to_gid(self, slot):
         """Cohort slot → global actor id (vectorised, numpy-friendly)."""
@@ -155,12 +157,16 @@ class Program:
         cohort's free-slot list among its spawner cohorts.
 
         ≙ pony_create's allocation (actor.c:688) done ahead of time: each
-        (spawner, target) pair owns a contiguous window of the target's
-        compacted free rows, sized worst-case (rows × batch × sites), so
-        concurrent vmapped spawns can never collide. The partition is the
-        TPU-static price: a spawner can exhaust *its window* while another
-        window still has slots. Reservations unused at the end of a step
-        simply remain free.
+        (spawner, target) pair owns a window of the target's compacted
+        free rows; within the window, each *runnable* actor gets
+        spawn_dispatches × sites disjoint slots (ranked by a cumsum over
+        the runnable mask at step time), so concurrent vmapped spawns can
+        never collide while idle actors reserve nothing. The static
+        partition *between* spawner cohorts is worst-case
+        (capacity × spawn_dispatches × sites) — the TPU-static price: a
+        second spawner cohort can exhaust its window while the first's
+        still has slots. Reservations unused at the end of a step simply
+        remain free.
         """
         by_name = {c.atype.__name__: c for c in self.cohorts}
         offsets: Dict[str, int] = {n: 0 for n in by_name}
@@ -181,8 +187,8 @@ class Program:
                     continue
                 cohort.spawns[tname] = int(sites)
                 cohort.spawn_offsets[tname] = offsets[tname]
-                offsets[tname] += (cohort.local_capacity * cohort.batch
-                                   * int(sites))
+                offsets[tname] += (cohort.local_capacity
+                                   * cohort.spawn_dispatches * int(sites))
 
     @property
     def has_device_spawns(self) -> bool:

@@ -174,13 +174,23 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
     nb = len(cohort.behaviours)
     base = cohort.behaviours[0].global_id if nb else 0
 
+    sd = cohort.spawn_dispatches
+
     def actor_fn(st_row, msgs, valids, actor_id, resv):
         # msgs: [batch, 1+W]; valids: [batch] bool;
-        # resv: {target: [batch, sites]} reserved refs per dispatch slot.
+        # resv: {target: [spawn_dispatches, sites]} reserved refs — a
+        # `used` counter hands one dispatch-worth of reservations to each
+        # spawning message; exceeding the SPAWN_DISPATCHES budget yields
+        # -1 refs (→ the sticky spawn_fail, never a double claim).
         def scan_body(carry, x):
-            (st, stopped, ef, ec, sfail, dstr, errf, errc, nproc,
+            (st, stopped, ef, ec, sfail, dstr, errf, errc, used, nproc,
              nbad) = carry
-            msg, valid, resv_k = x
+            msg, valid = x
+            resv_k = tuple(
+                jnp.where(used < sd,
+                          resv[t][jnp.minimum(used, sd - 1)],
+                          jnp.int32(-1))
+                for t, _ in spawn_sites)
             local = msg[0] - base
             in_range = (local >= 0) & (local < nb)
             do = valid & ~stopped
@@ -188,23 +198,27 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
             (st2, (stgt, swords), (bef, bec), yf, claims, bsf, bdstr,
              (bErrF, bErrC)) = lax.switch(bid, branches,
                                           (st, msg[1:], actor_id, resv_k))
+            spawned_here = bsf
+            for cl in claims:
+                if cl.shape[0]:
+                    spawned_here = spawned_here | jnp.any(cl >= 0)
             new_ef = ef | bef
             new_ec = jnp.where(bef & ~ef, bec, ec)
             stopped2 = stopped if noyield else (stopped | yf)
             return ((st2, stopped2, new_ef, new_ec, sfail | bsf,
                      dstr | bdstr, errf | bErrF,
                      jnp.where(bErrF, bErrC, errc),
+                     used + spawned_here.astype(jnp.int32),
                      nproc + (do & in_range).astype(jnp.int32),
                      nbad + (do & ~in_range).astype(jnp.int32)),
                     (stgt, swords, do, claims))
 
         carry0 = (st_row, jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
                   jnp.bool_(False), jnp.bool_(False), jnp.bool_(False),
-                  jnp.int32(0), jnp.int32(0), jnp.int32(0))
-        resv_xs = tuple(resv[t] for t, _ in spawn_sites)
-        ((stf, _, ef, ec, sfail, dstr, errf, errc, nproc, nbad),
+                  jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        ((stf, _, ef, ec, sfail, dstr, errf, errc, _used, nproc, nbad),
          (stgt, swords, consumed, claims)) = lax.scan(
-            scan_body, carry0, (msgs, valids, resv_xs))
+            scan_body, carry0, (msgs, valids))
         n_consumed = jnp.sum(consumed.astype(jnp.int32))
         return (stf, (stgt, swords), ef, ec, sfail, dstr, (errf, errc),
                 nproc, nbad, n_consumed, claims)
@@ -406,21 +420,32 @@ def build_step(program: Program, opts: RuntimeOptions):
             free_rows[tname] = jnp.where(vfree, s0 + perm.astype(jnp.int32),
                                          jnp.int32(-1))
 
-        def cohort_resv(ch):
-            resv = {}
-            for tname, sites in sorted(ch.spawns.items()):
-                need = ch.local_capacity * ch.batch * sites
-                off = ch.spawn_offsets[tname]
-                rows = jnp.take(free_rows[tname],
-                                off + jnp.arange(need, dtype=jnp.int32),
-                                mode="fill", fill_value=-1)
-                refs = jnp.where(rows >= 0, base + rows, jnp.int32(-1))
-                resv[tname] = refs.reshape(ch.local_capacity, ch.batch,
-                                           sites)
-            return resv
-
         # --- 2. drain + dispatch per cohort (≙ actor run loop).
         runnable = st.alive & ~muted
+
+        def cohort_resv(ch):
+            """Per-actor spawn reservations: runnable actors get disjoint
+            spawn_dispatches × sites windows into the target's free rows,
+            ranked by a cumsum over the runnable mask (idle actors
+            reserve nothing — see Program._resolve_spawns)."""
+            resv = {}
+            if not ch.spawns:
+                return resv
+            run_c = runnable[ch.local_start:ch.local_stop]
+            rank = jnp.cumsum(run_c.astype(jnp.int32)) - 1
+            sd = ch.spawn_dispatches
+            for tname, sites in sorted(ch.spawns.items()):
+                per = sd * sites
+                off = ch.spawn_offsets[tname]
+                widx = jnp.where(run_c, rank * per, 0)
+                idx = (off + widx[:, None]
+                       + jnp.arange(per, dtype=jnp.int32)[None, :])
+                rows = jnp.take(free_rows[tname], idx, mode="fill",
+                                fill_value=-1)
+                refs = jnp.where((rows >= 0) & run_c[:, None],
+                                 base + rows, jnp.int32(-1))
+                resv[tname] = refs.reshape(ch.local_capacity, sd, sites)
+            return resv
         new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
         head_segments: List[jnp.ndarray] = []
         out_entries: List[Entries] = []
