@@ -65,7 +65,15 @@ class DeliveryResult(NamedTuple):
 
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
-            shard_base) -> DeliveryResult:
+            shard_base, level=None, n_levels: int = 1) -> DeliveryResult:
+    """`level` ([E] int32, 0 = most urgent) folds the fork's actor
+    *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
+    inject) into the one sort: the composite key (target, level, arrival)
+    keeps per-target segments contiguous while ordering contenders by
+    priority — when a mailbox can't take everything this tick, higher
+    priority wins the slots and lower priority spills. Level 0 is
+    reserved for receiver-spill entries (FIFO: older must land first),
+    level 1 for host injections."""
     n, c = n_local, mailbox_cap
     tgt, sender, words = entries
     e = tgt.shape[0]
@@ -77,16 +85,23 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     to_dead = in_range & ~alive[tgt_c]
     valid = in_range & ~to_dead
 
-    key = jnp.where(valid, tgt, n).astype(jnp.int32)
+    if level is None:
+        level = jnp.zeros((e,), jnp.int32)
+        n_levels = 1
+    key = jnp.where(valid, tgt * n_levels + level,
+                    n * n_levels).astype(jnp.int32)
     perm = stable_sort_by(key)
-    kt = key[perm]
+    ks = key[perm]
+    kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
     wds = words[perm]
     ktc = jnp.minimum(kt, n - 1)
 
     # Per-target segment bounds: one vectorised binary search replaces the
-    # scatter-add histogram (see module docstring, point 4).
-    bounds = jnp.searchsorted(kt, jnp.arange(n + 1, dtype=jnp.int32),
-                              side="left").astype(jnp.int32)
+    # scatter-add histogram (see module docstring, point 4). Queries at
+    # target boundaries of the composite key span all priority levels.
+    bounds = jnp.searchsorted(
+        ks, jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
+        side="left").astype(jnp.int32)
     seg_start = bounds[:-1]                      # [n]
     cnt = bounds[1:] - seg_start                 # [n] msgs per target
     occ = tail - head

@@ -361,6 +361,16 @@ def build_step(program: Program, opts: RuntimeOptions):
     e_out = sum(ch.local_capacity * ch.batch * ch.max_sends
                 for ch in dev_cohorts)
     bucket = max(16, min(e_out + s_cap, 4 * (e_out + s_cap) // p))
+    # Delivery priority levels (see delivery.deliver): 0 = receiver
+    # spill, 1 = host inject, 2+k = sender cohort with k-th highest
+    # PRIORITY (≙ the fork's actor priority hint ordering contenders).
+    import numpy as _np
+    pri_sorted = sorted({ch.priority for ch in dev_cohorts}, reverse=True)
+    pri_rank = {pv: i for i, pv in enumerate(pri_sorted)}
+    n_levels = 2 + max(1, len(pri_sorted))
+    prio_row_np = _np.zeros((nl,), _np.int32)
+    for ch in dev_cohorts:
+        prio_row_np[ch.local_start:ch.local_stop] = pri_rank[ch.priority]
 
     def local_step(st: RtState, inject_tgt, inject_words
                    ) -> Tuple[RtState, StepAux]:
@@ -554,9 +564,19 @@ def build_step(program: Program, opts: RuntimeOptions):
                                    incoming.words]),
         )
 
+        prio_row = jnp.asarray(prio_row_np)
+        snd_in = incoming.sender
+        srow = jnp.where(snd_in >= 0, snd_in, 0) % nl
+        lvl_in = jnp.where(snd_in >= 0, 2 + prio_row[srow],
+                           jnp.int32(2)).astype(jnp.int32)
+        lvl_all = jnp.concatenate([
+            jnp.zeros_like(dspill_e.tgt),
+            jnp.ones_like(inj_local),
+            lvl_in])
         res = deliver(st.buf, new_head, tail0, alive, all_e,
                       n_local=nl, mailbox_cap=c, spill_cap=s_cap,
-                      overload_occ=opts.overload_occ, shard_base=base)
+                      overload_occ=opts.overload_occ, shard_base=base,
+                      level=lvl_all, n_levels=n_levels)
 
         # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
         # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
