@@ -150,6 +150,9 @@ class Program:
                 gid += 1
         self._resolve_spawns()
         self.frozen = True
+        from . import plugin as _plugin
+        if _plugin.active():
+            _plugin.run_build_hooks(self)
         return self
 
     def _resolve_spawns(self) -> None:

@@ -1,0 +1,88 @@
+"""Plugin hooks + docgen (≙ src/libponyc/plugin/plugin.c hook protocol
+and pass/docgen.c output)."""
+
+import os
+
+import pytest
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu import docgen, plugin
+
+
+@actor
+class Worker:
+    """Crunches numbers for the supervisor."""
+    boss: Ref
+    done: I32
+
+    @behaviour
+    def init(self, st, boss: Ref, job: I32):
+        """Constructor: remember the boss."""
+        return {**st, "boss": boss}
+
+
+@pytest.fixture(autouse=True)
+def _clean_plugins():
+    plugin.unregister_all()
+    yield
+    plugin.unregister_all()
+
+
+def test_plugin_hooks_run_in_order():
+    calls = []
+
+    class P:
+        name = "probe"
+
+        def init(self, program):
+            calls.append(("init", program.total))
+
+        def visit_cohort(self, program, cohort):
+            calls.append(("visit", cohort.atype.__name__))
+
+        def finalize(self, program):
+            calls.append(("finalize", len(program.behaviour_table)))
+
+        def help(self):
+            return "records build phases"
+
+        def parse_options(self, argv):
+            return [a for a in argv if a != "--probe"]
+
+    plugin.register(P())
+    rt = Runtime(RuntimeOptions(msg_words=2)).declare(Worker, 4)
+    rt.start()
+    assert calls == [("init", 4), ("visit", "Worker"), ("finalize", 1)]
+    assert plugin.parse_options(["x", "--probe", "y"]) == ["x", "y"]
+    assert "records build phases" in plugin.help_text()
+
+
+def test_plugin_load_by_import_path(tmp_path, monkeypatch):
+    (tmp_path / "fake_plug.py").write_text(
+        "class Plugin:\n"
+        "    name = 'fake'\n"
+        "    seen = []\n"
+        "    def finalize(self, program):\n"
+        "        Plugin.seen.append(program.total)\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    p = plugin.load("fake_plug")
+    Runtime(RuntimeOptions(msg_words=2)).declare(Worker, 2).start()
+    assert type(p).seen == [2]
+
+
+def test_docgen_program_and_tree(tmp_path):
+    rt = Runtime(RuntimeOptions(msg_words=2)).declare(Worker, 4)
+    rt.start()
+    md = docgen.document(rt.program, title="Demo")
+    assert "# Demo" in md
+    assert "## actor Worker" in md
+    assert "Crunches numbers" in md
+    assert "be init(boss: Ref, job: I32)" in md
+    assert "Constructor: remember the boss." in md
+    assert "| boss | Ref |" in md
+    files = docgen.write_tree(rt.program, str(tmp_path / "docs"))
+    assert os.path.exists(tmp_path / "docs" / "Worker.md")
+    assert os.path.exists(tmp_path / "docs" / "index.md")
+    idx = (tmp_path / "docs" / "index.md").read_text()
+    assert "[Worker](Worker.md)" in idx
+    assert len(files) == 2
