@@ -108,6 +108,25 @@ class Analysis:
             lines.append(f"{name}={rt.counter(name)}")
         lines.append(f"host_processed={rt.totals.get('host_processed', 0)} "
                      f"inject_queue={len(rt._inject_q)}")
+        # Memory accounting (≙ USE_MEMTRACK counters, scheduler.h:52-66):
+        # native pool blocks + host-heap handles.
+        try:
+            from . import native as _native
+            allocated, recycled = _native.pool_stats()
+            lines.append(f"pool_allocated={allocated} "
+                         f"pool_recycled={recycled}")
+        except Exception:               # native lib absent: skip silently
+            pass
+        heap = getattr(rt, "_heap", None)
+        if heap is not None:
+            s = heap.stats()
+            lines.append(
+                f"host_heap boxed={s['boxed']} unboxed={s['unboxed']} "
+                f"live={s['live']} peak={s['peak_live']}")
+        bridge = getattr(rt, "bridge", None)
+        if bridge is not None:
+            lines.append(f"asio_noisy={bridge.loop.noisy} "
+                         f"asio_pending={bridge.loop.pending()}")
         if rt.state is not None:
             occ = np.asarray(rt.state.tail) - np.asarray(rt.state.head)
             alive = np.asarray(rt.state.alive)

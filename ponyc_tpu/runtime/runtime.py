@@ -112,6 +112,7 @@ class Runtime:
     """
 
     def __init__(self, opts: Optional[RuntimeOptions] = None):
+        self._opts_defaulted = opts is None
         self.opts = opts or RuntimeOptions()
         self.program = Program(self.opts)
         self.state: Optional[RtState] = None
@@ -138,6 +139,19 @@ class Runtime:
         return self
 
     def start(self) -> "Runtime":
+        # ≙ Main_runtime_override_defaults_oo (start.c:99,214): a declared
+        # actor type may override runtime defaults — applied only when the
+        # caller didn't pass explicit options (explicit flags win, exactly
+        # like the reference's CLI > Main-override > default ordering).
+        if self._opts_defaulted:
+            import dataclasses as _dc
+            overrides = {}
+            for atype, _cap in self.program._declared:
+                overrides.update(getattr(atype, "RUNTIME_DEFAULTS", {}))
+            if overrides:
+                self.opts = _dc.replace(self.opts, **overrides)
+                self.program.opts = self.opts
+                self.program.shards = max(1, self.opts.mesh_shards)
         self.program.finalize()
         self.state = init_state(self.program, self.opts)
         if self.program.shards > 1:

@@ -83,3 +83,26 @@ def test_strip_runtime_flags():
     assert rest == ["prog", "user-arg"]
     with pytest.raises(ValueError):
         strip_runtime_flags(["prog", "--pony_batch"])
+
+
+def test_runtime_defaults_override():
+    # ≙ Main_runtime_override_defaults_oo (start.c:99,214): a declared
+    # type's RUNTIME_DEFAULTS apply when the caller passed no options;
+    # explicit options win.
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+    @actor
+    class Tuned:
+        RUNTIME_DEFAULTS = {"mailbox_cap": 32, "batch": 3}
+        x: I32
+
+        @behaviour
+        def nop(self, st):
+            return st
+
+    rt = Runtime().declare(Tuned, 2).start()
+    assert rt.opts.mailbox_cap == 32 and rt.opts.batch == 3
+    rt2 = Runtime(RuntimeOptions(mailbox_cap=8, msg_words=1,
+                                 batch=1, max_sends=1))
+    rt2.declare(Tuned, 2).start()
+    assert rt2.opts.mailbox_cap == 8      # explicit options win
