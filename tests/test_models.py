@@ -58,3 +58,23 @@ def test_nbody_matches_all_pairs_oracle():
     ax, ay = nbody.reference_accels(st["x"], st["y"], st["m"])
     np.testing.assert_allclose(st["ax"], ax, rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(st["ay"], ay, rtol=2e-4, atol=2e-5)
+
+
+def test_gups_opt_batched_updates():
+    # ≙ examples/gups_opt: K updates per dispatch; same xor-conservation
+    # oracle, K× the per-tick throughput.
+    import numpy as np
+    rt = gups.run_opt(table_size=512, n_updaters=8, ticks_each=4)
+    upd = rt.cohort_state(gups.OptUpdater)
+    K = gups.OptUpdater.K
+    assert (upd["done"] == 4 * K).all()
+    cells = rt.cohort_state(gups.TableCell)["value"]
+    x = np.asarray(
+        np.random.default_rng(11).integers(1, 2**31 - 1, 8), np.int32)
+    expect = np.int32(0)
+    for _ in range(4 * K):
+        x = (x ^ (x << 13)).astype(np.int32)
+        x = (x ^ ((x >> 17) & 0x7FFF)).astype(np.int32)
+        x = (x ^ (x << 5)).astype(np.int32)
+        expect ^= np.bitwise_xor.reduce(x)
+    assert np.bitwise_xor.reduce(cells) == expect
