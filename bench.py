@@ -37,6 +37,8 @@ def main():
     ap.add_argument("--ticks", type=int,
                     default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
     ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--cap", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
 
@@ -44,8 +46,10 @@ def main():
     from ponyc_tpu import RuntimeOptions
     from ponyc_tpu.models import ubench
 
-    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
-                          spill_cap=1024, inject_slots=8)
+    # cap 4 suffices for the 1-in-flight steady state and keeps the ring
+    # rebuild (cap-proportional) lean.
+    opts = RuntimeOptions(mailbox_cap=args.cap, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=1024, inject_slots=8)
     t0 = time.time()
     rt, ids = ubench.build(args.actors, opts)
     ubench.seed_all(rt, ids, hops=1 << 30)   # effectively infinite
