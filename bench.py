@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Headline benchmark: message-ubench throughput on one chip.
+"""Headline benchmark: message-ubench throughput + p50 dispatch latency.
 
 Reproduces the reference's `examples/message-ubench` metric
 (actor-messages/sec; BASELINE.md) at benchmark scale: N pingers in one
@@ -8,19 +8,35 @@ tick dispatches exactly N behaviours and routes N messages, so
 
     msgs/sec = N × ticks / elapsed.
 
+Also measures the second tracked BASELINE metric: p50 behaviour-dispatch
+latency, via a single-token 1024-actor ring (≙ examples/ring/main.pony) —
+each tick is one hop, timed individually with a device sync.
+
 vs_baseline: the reference publishes no absolute numbers (BASELINE.md —
 "published: {}"); the driver-set north star is ≥10× message-ubench on a
 32-core CPU. We use 3.0e8 msgs/s as the 32-core CPU estimate (Pony's
 ubench sustains O(10M) msgs/core/s on modern x86), so vs_baseline 10.0
 == the north-star 10× target.
 
-Usage: python bench.py  [--actors N] [--ticks K] (defaults 2^20, 200)
-Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS override.
+Platform handling (round-2 fix): the TPU backend behind the axon tunnel
+can fail or hang on init, and the plugin re-asserts itself over
+JAX_PLATFORMS. The backend is therefore probed in a *subprocess* with a
+timeout (a hung in-process jax.devices() would wedge this process's
+backend lock forever), retried, and on persistent failure the bench falls
+back to CPU — loudly, with the TPU error in the JSON detail — so a run
+always captures a parseable result. Set PONY_TPU_BENCH_ALLOW_CPU=0 to
+make TPU-init failure fatal instead, or --platform cpu for smoke runs.
+
+Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
+Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
+       PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU override.
 """
 
 import argparse
 import json
 import os
+import statistics
+import subprocess
 import sys
 import time
 
@@ -28,20 +44,52 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 CPU32_BASELINE_MSGS_PER_SEC = 3.0e8
 
+_PROBE_SRC = "import jax; d = jax.devices(); print('PLAT:' + d[0].platform)"
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--actors", type=int,
-                    default=int(os.environ.get("PONY_TPU_BENCH_ACTORS",
-                                               1 << 20)))
-    ap.add_argument("--ticks", type=int,
-                    default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
-    ap.add_argument("--warmup", type=int, default=20)
-    ap.add_argument("--cap", type=int,
-                    default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
-    args = ap.parse_args()
-    args.warmup = max(1, args.warmup)   # the first step pays the jit
 
+def probe_tpu(timeout_s: float, retries: int):
+    """Initialise JAX in a subprocess and report the default platform.
+
+    Returns (platform_or_None, last_error). A hung init (observed: the
+    axon backend blocking >10 min) only costs the subprocess."""
+    err = None
+    for attempt in range(1, retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s)
+            out = r.stdout or ""
+            plat = None
+            for line in out.splitlines():
+                if line.startswith("PLAT:"):
+                    plat = line[5:].strip()
+            if r.returncode == 0 and plat and plat != "cpu":
+                return plat, None
+            if r.returncode == 0:
+                # Deterministic outcome — JAX resolved to CPU; retrying
+                # would just re-init the same backend.
+                err = f"backend initialised as {plat!r}, not a TPU"
+                print(f"bench: TPU probe: {err}", file=sys.stderr)
+                return None, err
+            else:
+                err = (r.stderr or out).strip()[-1500:] or \
+                    f"probe exited rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            err = (f"jax.devices() did not return within {timeout_s:.0f}s "
+                   "(backend init hang)")
+        print(f"bench: TPU probe attempt {attempt}/{retries} failed: {err}",
+              file=sys.stderr)
+        if attempt < retries:
+            time.sleep(5.0)
+    return None, err
+
+
+def force_cpu():
+    from ponyc_tpu.platforms import force_cpu as _force
+    _force()
+
+
+def bench_ubench(args):
     import jax
     from ponyc_tpu import RuntimeOptions
     from ponyc_tpu.models import ubench
@@ -74,7 +122,98 @@ def main():
 
     processed = rt.counter("n_processed") & 0xFFFFFFFF
     expect = (args.warmup + args.ticks) * args.actors
-    msgs_per_sec = args.actors * args.ticks / elapsed
+    return {
+        "msgs_per_sec": args.actors * args.ticks / elapsed,
+        "elapsed_s": elapsed,
+        "tick_ms": 1e3 * elapsed / args.ticks,
+        "processed_counter_ok": bool(processed == expect % (1 << 32)),
+        "build_s": build_s,
+        "warmup_s": warm_s,
+    }
+
+
+def bench_latency(args):
+    """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
+    one hop per tick, each tick individually synced and timed."""
+    import jax
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ring
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                          spill_cap=64, inject_slots=8)
+    rt, ids = ring.build(args.lat_actors, opts)
+    rt.send(int(ids[0]), ring.RingNode.token, 1 << 30)
+    inj = rt._drain_inject()
+    state, aux = rt._step(rt.state, *inj)     # pays the jit + injects token
+    jax.block_until_ready(aux)
+    inj = rt._empty_inject
+    for _ in range(10):                       # warm steady-state path
+        state, aux = rt._step(state, *inj)
+    jax.block_until_ready(aux)
+    times = []
+    for _ in range(args.lat_ticks):
+        t0 = time.perf_counter()
+        state, aux = rt._step(state, *inj)
+        jax.block_until_ready(aux)
+        times.append(time.perf_counter() - t0)
+    rt.state = state
+    hops = int(rt.cohort_state(ring.RingNode)["passes"].sum())
+    return {
+        "p50_us": 1e6 * statistics.median(times),
+        "p90_us": 1e6 * sorted(times)[int(0.9 * len(times))],
+        # inject step delivers but doesn't dispatch (dispatch precedes
+        # delivery in the step), so hops = warmup(10) + lat_ticks.
+        "hops_ok": bool(hops == 10 + args.lat_ticks),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_ACTORS",
+                                               1 << 20)))
+    ap.add_argument("--ticks", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--cap", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
+    ap.add_argument("--lat-actors", type=int, default=1024)
+    ap.add_argument("--lat-ticks", type=int, default=200)
+    ap.add_argument("--platform",
+                    default=os.environ.get("PONY_TPU_BENCH_PLATFORM",
+                                           "auto"),
+                    choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--probe-timeout", type=float,
+                    default=float(os.environ.get(
+                        "PONY_TPU_BENCH_PROBE_TIMEOUT", 180.0)))
+    ap.add_argument("--probe-retries", type=int, default=3)
+    args = ap.parse_args()
+    args.warmup = max(1, args.warmup)   # the first step pays the jit
+    args.lat_ticks = max(1, args.lat_ticks)
+
+    allow_cpu = os.environ.get("PONY_TPU_BENCH_ALLOW_CPU", "1") != "0"
+    tpu_error = None
+    if args.platform == "cpu":
+        force_cpu()
+    elif args.platform == "auto":
+        plat, tpu_error = probe_tpu(args.probe_timeout, args.probe_retries)
+        if plat is None:
+            if not allow_cpu:
+                print(json.dumps({"error": "tpu_init_failed",
+                                  "detail": tpu_error}))
+                sys.exit(1)
+            print("bench: TPU unavailable — falling back to CPU "
+                  "(PONY_TPU_BENCH_ALLOW_CPU=0 to make this fatal). "
+                  f"Last error: {tpu_error}", file=sys.stderr)
+            force_cpu()
+    # --platform tpu: no forcing, let init fail loudly in-process.
+
+    import jax
+    plat = jax.devices()[0].platform
+
+    ub = bench_ubench(args)
+    lat = bench_latency(args)
+    msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
         "metric": "ubench_actor_messages_per_sec",
@@ -84,14 +223,20 @@ def main():
         "detail": {
             "actors": args.actors,
             "ticks": args.ticks,
-            "elapsed_s": round(elapsed, 4),
-            "tick_ms": round(1e3 * elapsed / args.ticks, 3),
-            "processed_counter_ok": bool(processed == expect % (1 << 32)),
-            "build_s": round(build_s, 1),
-            "warmup_s": round(warm_s, 1),
-            "platform": jax.devices()[0].platform,
+            "elapsed_s": round(ub["elapsed_s"], 4),
+            "tick_ms": round(ub["tick_ms"], 3),
+            "processed_counter_ok": ub["processed_counter_ok"],
+            "build_s": round(ub["build_s"], 1),
+            "warmup_s": round(ub["warmup_s"], 1),
+            "platform": plat,
+            "p50_dispatch_latency_us": round(lat["p50_us"], 1),
+            "p90_dispatch_latency_us": round(lat["p90_us"], 1),
+            "latency_ring_actors": args.lat_actors,
+            "latency_hops_ok": lat["hops_ok"],
         },
     }
+    if tpu_error is not None:
+        result["detail"]["tpu_init_error"] = tpu_error
     print(json.dumps(result))
 
 

@@ -60,8 +60,9 @@ class Net:
     def __init__(self, rt):
         self.rt = rt
         self.bridge = rt.attach_bridge()
-        self._listeners: Dict[int, Tuple[int, int, BehaviourDef,
-                                         BehaviourDef, BehaviourDef]] = {}
+        self._listeners: Dict[int, Tuple[int, int, int,
+                                         Tuple[BehaviourDef, BehaviourDef,
+                                               BehaviourDef]]] = {}
         self._conns: Dict[int, _Conn] = {}
         self._udp: Dict[int, Tuple[int, int, BehaviourDef]] = {}
         self._next = 1

@@ -1,0 +1,30 @@
+"""Platform forcing for CPU smoke/test runs.
+
+The environment's axon TPU plugin re-asserts itself over the
+``JAX_PLATFORMS`` env var at import time; the only reliable way to get
+the CPU backend is the config knob *after* importing jax. The
+``xla_force_host_platform_device_count`` flag must land before the CPU
+client is created (first ``jax.devices()`` / trace), which calling this
+helper early guarantees.
+
+One definition, three callers: tests/conftest.py (8-device virtual mesh),
+__graft_entry__.dryrun_multichip (driver validation), bench.py (smoke
+runs / TPU-init fallback).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU backend, optionally with n virtual devices."""
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")

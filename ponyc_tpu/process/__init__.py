@@ -31,7 +31,8 @@ from ..native import sockets as S
 
 class _Proc:
     __slots__ = ("pid", "owner", "on_stdout", "on_stderr", "on_exit",
-                 "stdin_fd", "fds", "subs", "eofs", "exit_code", "done")
+                 "stdin_fd", "stdin_buf", "stdin_closing", "fds", "subs",
+                 "eofs", "exit_code", "done")
 
     def __init__(self, pid, owner, on_stdout, on_stderr, on_exit,
                  stdin_fd, out_fd, err_fd):
@@ -41,6 +42,8 @@ class _Proc:
         self.on_stderr = on_stderr
         self.on_exit = on_exit
         self.stdin_fd = stdin_fd
+        self.stdin_buf = b""        # unwritten tail, flushed at polls
+        self.stdin_closing = False  # close_stdin() called, buffer pending
         self.fds = {"out": out_fd, "err": err_fd}
         self.subs: Dict[str, int] = {}
         self.eofs = 0
@@ -118,23 +121,42 @@ class Processes:
 
     # -- stdin (≙ ProcessMonitor.write/done_writing) --
     def write(self, proc_id: int, data: bytes) -> None:
+        """Queue bytes for the child's stdin. The whole buffer is always
+        accepted: whatever the pipe can't take now is kept host-side and
+        flushed at poll boundaries (as Net does for sockets), so a full
+        pipe never loses or duplicates data."""
         p = self._procs[proc_id]
-        if p.stdin_fd is None:
+        if p.stdin_fd is None or p.stdin_closing:
             raise ValueError("stdin already closed")
-        view = memoryview(bytes(data))
-        while view:
-            try:
-                n = os.write(p.stdin_fd, view)   # pipe: write, not send
-            except BlockingIOError:
-                raise BlockingIOError(
-                    "child stdin pipe full; write less per step")
-            view = view[n:]
+        p.stdin_buf += bytes(data)
+        self._flush_stdin(p)
 
-    def close_stdin(self, proc_id: int) -> None:
-        p = self._procs[proc_id]
-        if p.stdin_fd is not None:
+    def _flush_stdin(self, p: _Proc) -> None:
+        while p.stdin_buf and p.stdin_fd is not None:
+            try:
+                n = os.write(p.stdin_fd, p.stdin_buf)  # pipe: write
+            except BlockingIOError:
+                return                 # pipe full; retry at next poll
+            except OSError:
+                # Child closed its end (EPIPE): drop the buffer and close
+                # our side so the next write() raises (≙ ProcessMonitor's
+                # failed-write shutdown) instead of silently discarding.
+                p.stdin_buf = b""
+                S.close(p.stdin_fd)
+                p.stdin_fd = None
+                return
+            p.stdin_buf = p.stdin_buf[n:]
+        if p.stdin_closing and not p.stdin_buf and p.stdin_fd is not None:
             S.close(p.stdin_fd)
             p.stdin_fd = None
+
+    def close_stdin(self, proc_id: int) -> None:
+        """≙ ProcessMonitor.done_writing: close once queued bytes flush."""
+        p = self._procs[proc_id]
+        if p.stdin_fd is None:
+            return
+        p.stdin_closing = True
+        self._flush_stdin(p)
 
     def kill(self, proc_id: int, signum: int = 15) -> None:
         """≙ ProcessMonitor.dispose."""
@@ -146,6 +168,8 @@ class Processes:
         for proc_id, p in list(self._procs.items()):
             if p.done:
                 continue
+            if p.stdin_buf:
+                self._flush_stdin(p)
             if p.exit_code is None:
                 p.exit_code = P.check(p.pid)
             # Once the child has exited, sweep both streams: everything it

@@ -115,7 +115,7 @@ class Runtime:
         self._opts_defaulted = opts is None
         self.opts = opts or RuntimeOptions()
         self.program = Program(self.opts)
-        self.state: Optional[RtState] = None
+        self.state: Optional[RtState] = None  # via the property below
         self._step = None
         self._inject_q: collections.deque = collections.deque()
         self._free: Dict[str, List[int]] = {}
@@ -128,10 +128,24 @@ class Runtime:
         self.totals = collections.Counter()    # lifetime stats (host ints)
         self._last_counters: Dict[str, int] = {}
         self._gc_fn = None
+        self._freelist_key = None   # None = stale; "synced" = cache valid
         self._ref_mask = None
         self._ever_released = False
         self._last_gc_step = 0
         self._host_errors: Dict[int, int] = {}
+
+    # Any state assignment — including a driver pushing rt._step results
+    # back, as bench.py does — conservatively invalidates the cached
+    # freelists; internal writers that provably keep them consistent
+    # restore _freelist_key after assigning.
+    @property
+    def state(self) -> Optional[RtState]:
+        return self._state
+
+    @state.setter
+    def state(self, v) -> None:
+        self._state = v
+        self._freelist_key = None
 
     # ---- construction (≙ pony_init) ----
     def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
@@ -190,17 +204,17 @@ class Runtime:
         unknown = set(fields) - set(atype.field_specs)
         if unknown:
             raise TypeError(f"{atype.__name__} has no fields {unknown}")
-        free = self._free[atype.__name__]
         if not cohort.host and (self.program.has_device_spawns
                                 or self.steps_run):
             # Device-side spawn/destroy/GC may have claimed or freed slots
-            # behind the host freelist's back — rebuild from device truth
-            # (highest slot first, matching the initial freelist order).
-            alive = np.asarray(jax.device_get(self.state.alive))
-            all_slots = np.arange(cohort.capacity - 1, -1, -1)
-            gids = np.asarray(cohort.slot_to_gid(all_slots))
-            free = [int(s) for s, g in zip(all_slots, gids) if not alive[g]]
-            self._free[atype.__name__] = free
+            # behind the host freelist's back. Sync from device truth at
+            # most once per world mutation (the state setter invalidates
+            # _freelist_key): a setup loop of spawn calls with no steps in
+            # between pays one device fetch, not one per call.
+            if self._freelist_key is None:
+                self._rebuild_freelists()
+        fkey = self._freelist_key
+        free = self._free[atype.__name__]
         if len(free) < count:
             raise RuntimeError(
                 f"cohort {atype.__name__} capacity exhausted "
@@ -238,7 +252,41 @@ class Runtime:
             alive=self.state.alive.at[ids].set(True),
             # The caller now holds these refs: GC roots until release().
             pinned=self.state.pinned.at[ids].set(True))
+        # Our own pops/sets kept the cached freelists consistent.
+        self._freelist_key = fkey
         return ids
+
+    def _rebuild_freelists(self) -> None:
+        """Refresh every device cohort's freelist from device truth.
+
+        A slot is free only if it is dead, its queue is drained, AND no
+        message addressed to it is parked in either spill tier — the same
+        free_ok condition the device spawn path enforces (engine.py step
+        1b). Reclaiming a row with a stale spilled message would deliver a
+        previous life's message to the newborn."""
+        st = self.state
+        alive, head, tail, dsp, rsp = (
+            np.asarray(x) for x in jax.device_get(
+                (st.alive, st.head, st.tail, st.dspill_tgt, st.rspill_tgt)))
+        n = self.program.total
+        nl = self.program.n_local
+        s_cap = self.opts.spill_cap
+        spill_hit = np.zeros((n,), bool)
+        shard = np.arange(dsp.shape[0]) // s_cap   # dspill targets: local
+        ok = dsp >= 0
+        spill_hit[shard[ok] * nl + dsp[ok]] = True
+        ok = (rsp >= 0) & (rsp < n)                # rspill targets: global
+        spill_hit[rsp[ok]] = True
+        free_ok = ~alive & (tail - head == 0) & ~spill_hit
+        for cohort in self.program.cohorts:
+            if cohort.host:
+                continue
+            # Highest slot first, matching the initial freelist order.
+            all_slots = np.arange(cohort.capacity - 1, -1, -1)
+            gids = np.asarray(cohort.slot_to_gid(all_slots))
+            self._free[cohort.atype.__name__] = [
+                int(s) for s, g in zip(all_slots, gids) if free_ok[g]]
+        self._freelist_key = "synced"
 
     # ---- GC pinning (≙ ORCA's external rc: an actor is born with one
     # reference owned by its creator, actor.c:688-734) ----
@@ -246,15 +294,19 @@ class Runtime:
         """Drop the host's reference(s): the actors become collectable as
         soon as they are unreachable and message-quiet (gc.py)."""
         ids = np.asarray(ids, np.int32).reshape(-1)
+        fkey = self._freelist_key
         self.state = self._replace(
             pinned=self.state.pinned.at[ids].set(False))
+        self._freelist_key = fkey   # pinning doesn't affect slot freedom
         self._ever_released = True
 
     def pin(self, ids) -> None:
         """(Re-)pin actors as host-held GC roots."""
         ids = np.asarray(ids, np.int32).reshape(-1)
+        fkey = self._freelist_key
         self.state = self._replace(
             pinned=self.state.pinned.at[ids].set(True))
+        self._freelist_key = fkey   # pinning doesn't affect slot freedom
 
     def gc(self) -> int:
         """Run one collection: trace reachability from the roots, free
@@ -317,7 +369,9 @@ class Runtime:
             ts[fname] = col.at[cols].set(val)
         new_ts = dict(self.state.type_state)
         new_ts[atype.__name__] = ts
+        fkey = self._freelist_key
         self.state = self._replace(type_state=new_ts)
+        self._freelist_key = fkey   # column writes don't affect freedom
 
     # ---- external sends (≙ pony_sendv from outside the runtime) ----
     def send(self, target: int, behaviour_def: BehaviourDef, *args):

@@ -1,21 +1,18 @@
-"""Test env: force an 8-device virtual CPU mesh before JAX import.
+"""Test env: force an 8-device virtual CPU mesh before any JAX use.
 
 ≙ the reference's fake-stdlib/PassTest fixture strategy (test/libponyc/
 util.h:32-82): tests run against a controllable substrate rather than the
 real target. Multi-chip sharding tests use these 8 virtual devices; the
-real TPU is exercised only by bench.py.
+real TPU is exercised only by bench.py. The forcing dance (env var +
+post-import config knob, needed because the axon TPU plugin re-asserts
+itself over JAX_PLATFORMS) lives in ponyc_tpu.platforms.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"   # override the env's axon default
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon TPU plugin re-asserts itself over JAX_PLATFORMS at import time;
-# the config knob set after import is authoritative.
-import jax  # noqa: E402
+from ponyc_tpu.platforms import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
