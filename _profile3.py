@@ -1,0 +1,102 @@
+"""Round-2 profile: where does the ubench tick go? (component timings)"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from ponyc_tpu.platforms import force_cpu
+if "tpu" not in sys.argv:
+    force_cpu()
+
+import jax
+import jax.numpy as jnp
+
+from ponyc_tpu import RuntimeOptions
+from ponyc_tpu.models import ubench
+from ponyc_tpu.runtime import engine
+
+N = 1 << 20
+CAP = 4
+
+
+def timeit(name, fn, *args, reps=10, jit=True):
+    r = jax.jit(fn) if jit else fn
+    out = r(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = r(*args)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:44s} {dt:8.3f} ms")
+    return out
+
+
+opts = RuntimeOptions(mailbox_cap=CAP, batch=1, max_sends=1, msg_words=1,
+                      spill_cap=1024, inject_slots=8)
+rt, ids = ubench.build(N, opts)
+ubench.seed_all(rt, ids, hops=1 << 30)
+st = rt.state
+print("platform:", jax.devices()[0].platform)
+
+# full step (donated arg: carry the chain forward)
+inj = rt._empty_inject
+s2, aux = rt._step(st, *inj)
+jax.block_until_ready(aux)
+t0 = time.time()
+for _ in range(10):
+    s2, aux = rt._step(s2, *inj)
+jax.block_until_ready(aux)
+print(f"{'FULL STEP':44s} {(time.time() - t0) / 10 * 1e3:8.3f} ms")
+st = s2
+rt.state = s2
+
+# dispatch only
+ch = rt.program.device_cohorts[0]
+disp = engine._cohort_dispatch(ch, opts, opts.noyield)
+idsj = jnp.arange(N, dtype=jnp.int32)
+
+
+def dispatch_only(state):
+    occ = state.tail - state.head
+    runnable = state.alive & ~state.muted
+    return disp(state.type_state[ch.atype.__name__], state.buf,
+                state.head, occ, runnable, idsj, {})
+
+
+out = timeit("dispatch (gather+scan+switch+outbox)", dispatch_only, st)
+
+# delivery parts
+tgt = jnp.asarray(out[1].tgt)
+words = jnp.asarray(out[1].words)
+E = tgt.shape[0]
+print("outbox E =", E)
+key = jnp.where(tgt >= 0, tgt, N).astype(jnp.int32)
+
+timeit("argsort(stable) of keys", lambda k: jnp.argsort(k, stable=True), key)
+perm = jnp.argsort(key, stable=True)
+timeit("payload gather words[perm]",
+       lambda w, p: w[p], words, perm)
+ks = key[perm]
+timeit("searchsorted bounds",
+       lambda s: jnp.searchsorted(s, jnp.arange(N + 1, dtype=jnp.int32),
+                                  side="left"), ks)
+bounds = jnp.searchsorted(ks, jnp.arange(N + 1, dtype=jnp.int32),
+                          side="left").astype(jnp.int32)
+seg = bounds[:-1]
+wds = words[perm]
+
+
+def ring_rebuild(buf, tail, seg_start, wds2):
+    slots = jnp.arange(CAP, dtype=jnp.int32)[None, :]
+    rel = (slots - tail[:, None]) % CAP
+    acc = jnp.minimum(bounds[1:] - seg_start, 1)
+    wmask = rel < acc[:, None]
+    src = jnp.minimum(seg_start[:, None] + rel, E - 1)
+    return jnp.where(wmask[:, :, None], wds2[src], buf)
+
+
+timeit("ring rebuild (dense gather+where)", ring_rebuild,
+       st.buf, st.tail, seg, wds)
+
+timeit("key equality check (cache validate)",
+       lambda a, b: jnp.all(a == b), key, key)

@@ -15,16 +15,21 @@ runs / TPU-init fallback).
 from __future__ import annotations
 
 import os
+import re
 
 
 def force_cpu(n_devices: int | None = None) -> None:
     """Force the CPU backend, optionally with n virtual devices."""
     if n_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+        # Replace any pre-existing value (a stale =1 from the environment
+        # would silently win and shrink every virtual mesh).
+        flags, n_subs = re.subn(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        if not n_subs:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")

@@ -42,7 +42,7 @@ class _Proc:
         self.on_stderr = on_stderr
         self.on_exit = on_exit
         self.stdin_fd = stdin_fd
-        self.stdin_buf = b""        # unwritten tail, flushed at polls
+        self.stdin_buf = bytearray()  # unwritten tail, flushed at polls
         self.stdin_closing = False  # close_stdin() called, buffer pending
         self.fds = {"out": out_fd, "err": err_fd}
         self.subs: Dict[str, int] = {}
@@ -128,24 +128,31 @@ class Processes:
         p = self._procs[proc_id]
         if p.stdin_fd is None or p.stdin_closing:
             raise ValueError("stdin already closed")
-        p.stdin_buf += bytes(data)
+        p.stdin_buf += data
         self._flush_stdin(p)
 
     def _flush_stdin(self, p: _Proc) -> None:
-        while p.stdin_buf and p.stdin_fd is not None:
-            try:
-                n = os.write(p.stdin_fd, p.stdin_buf)  # pipe: write
-            except BlockingIOError:
-                return                 # pipe full; retry at next poll
-            except OSError:
-                # Child closed its end (EPIPE): drop the buffer and close
-                # our side so the next write() raises (≙ ProcessMonitor's
-                # failed-write shutdown) instead of silently discarding.
-                p.stdin_buf = b""
-                S.close(p.stdin_fd)
-                p.stdin_fd = None
-                return
-            p.stdin_buf = p.stdin_buf[n:]
+        written = 0
+        view = memoryview(p.stdin_buf)
+        try:
+            while written < len(view) and p.stdin_fd is not None:
+                try:
+                    n = os.write(p.stdin_fd, view[written:])  # pipe: write
+                except BlockingIOError:
+                    return             # pipe full; retry at next poll
+                except OSError:
+                    # Child closed its end (EPIPE): drop the buffer and
+                    # close our side so the next write() raises (≙
+                    # ProcessMonitor's failed-write shutdown) instead of
+                    # silently discarding.
+                    written = len(view)
+                    S.close(p.stdin_fd)
+                    p.stdin_fd = None
+                    return
+                written += n
+        finally:
+            view.release()
+            del p.stdin_buf[:written]
         if p.stdin_closing and not p.stdin_buf and p.stdin_fd is not None:
             S.close(p.stdin_fd)
             p.stdin_fd = None

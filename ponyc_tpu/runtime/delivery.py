@@ -61,11 +61,15 @@ class DeliveryResult(NamedTuple):
     n_delivered: jnp.ndarray
     n_rejected: jnp.ndarray
     n_deadletter: jnp.ndarray
+    plan_key: jnp.ndarray      # [E] the key vector this plan sorts
+    plan_perm: jnp.ndarray     # [E] cached stable-sort permutation
+    plan_bounds: jnp.ndarray   # [n_local+1] cached segment bounds
 
 
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
-            shard_base, level=None, n_levels: int = 1) -> DeliveryResult:
+            shard_base, level=None, n_levels: int = 1,
+            plan=None) -> DeliveryResult:
     """`level` ([E] int32, 0 = most urgent) folds the fork's actor
     *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
     inject) into the one sort: the composite key (target, level, arrival)
@@ -90,18 +94,41 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
         n_levels = 1
     key = jnp.where(valid, tgt * n_levels + level,
                     n * n_levels).astype(jnp.int32)
-    perm = stable_sort_by(key)
-    ks = key[perm]
+
+    # --- the delivery plan: stable-sort permutation + per-target segment
+    # bounds (one vectorised binary search replaces the scatter-add
+    # histogram — see module docstring, point 4; queries at target
+    # boundaries of the composite key span all priority levels).
+    #
+    # Topology-stable traffic (every sustained benchmark's steady state:
+    # ubench's in-flight cycle, fan-in's hot edges) produces the *same*
+    # key vector tick after tick — the same actors firing along the same
+    # refs at the same priorities. The plan is therefore cached in the
+    # runtime state and revalidated with one cheap vector compare; the
+    # O(E log² E) sort re-runs under `lax.cond` only when traffic
+    # actually changes shape. ≙ the reference's O(1) pointer-based
+    # messageq push (messageq.c:102-160): its "plan" is the receiver
+    # pointer each sender holds; ours is the sort amortised across ticks.
+    def _compute_plan(k):
+        p_ = stable_sort_by(k)
+        b_ = jnp.searchsorted(
+            k[p_], jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
+            side="left").astype(jnp.int32)
+        return p_, b_
+
+    if plan is None:
+        perm, bounds = _compute_plan(key)
+    else:
+        plan_key, plan_perm, plan_bounds = plan
+        perm, bounds = lax.cond(
+            jnp.all(key == plan_key),
+            lambda _: (plan_perm, plan_bounds),
+            lambda _: _compute_plan(key),
+            operand=None)
+
     kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
     wds = words[perm]
     ktc = jnp.minimum(kt, n - 1)
-
-    # Per-target segment bounds: one vectorised binary search replaces the
-    # scatter-add histogram (see module docstring, point 4). Queries at
-    # target boundaries of the composite key span all priority levels.
-    bounds = jnp.searchsorted(
-        ks, jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
-        side="left").astype(jnp.int32)
     seg_start = bounds[:-1]                      # [n]
     cnt = bounds[1:] - seg_start                 # [n] msgs per target
     occ = tail - head
@@ -174,4 +201,5 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
         n_delivered=n_delivered,
         n_rejected=nrej,
         n_deadletter=n_deadletter,
+        plan_key=key, plan_perm=perm, plan_bounds=bounds,
     )

@@ -45,7 +45,7 @@ from ..ops import pack
 from ..ops.segment import compact_mask, counts_by_key, stable_sort_by
 from ..program import Cohort, Program
 from .delivery import Entries, deliver
-from .state import RtState
+from .state import RtState, layout_sizes
 
 
 class StepAux(NamedTuple):
@@ -356,11 +356,7 @@ def build_step(program: Program, opts: RuntimeOptions):
     dev_cohorts = program.device_cohorts
     dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield), ch)
                    for ch in dev_cohorts]
-    # all_to_all bucket size: worst case one shard receives everything;
-    # keep buckets at outbox-size/shards ×4 (tunable; overflow is safe).
-    e_out = sum(ch.local_capacity * ch.batch * ch.max_sends
-                for ch in dev_cohorts)
-    bucket = max(16, min(e_out + s_cap, 4 * (e_out + s_cap) // p))
+    e_out, bucket, _n_entries = layout_sizes(program, opts)
     # Delivery priority levels (see delivery.deliver): 0 = receiver
     # spill, 1 = host inject, 2+k = sender cohort with k-th highest
     # PRIORITY (≙ the fork's actor priority hint ordering contenders).
@@ -576,7 +572,8 @@ def build_step(program: Program, opts: RuntimeOptions):
         res = deliver(st.buf, new_head, tail0, alive, all_e,
                       n_local=nl, mailbox_cap=c, spill_cap=s_cap,
                       overload_occ=opts.overload_occ, shard_base=base,
-                      level=lvl_all, n_levels=n_levels)
+                      level=lvl_all, n_levels=n_levels,
+                      plan=(st.plan_key, st.plan_perm, st.plan_bounds))
 
         # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
         # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
@@ -701,6 +698,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_collected=st.n_collected,
             last_error=last_error,
             n_errors=vec(st.n_errors[0] + n_errors),
+            plan_key=res.plan_key, plan_perm=res.plan_perm,
+            plan_bounds=res.plan_bounds,
             type_state=new_type_state,
         )
         aux = StepAux(

@@ -207,6 +207,11 @@ def build_gc(program: Program, opts: RuntimeOptions):
             n_collected=st.n_collected + n_dead.reshape(1),
             last_error=jnp.where(dead, 0, st.last_error),
             n_errors=st.n_errors,
+            # Plan cache passes through: next step's key vector is
+            # computed against the new `alive`, so deliveries to
+            # collected actors invalidate it by comparison, not here.
+            plan_key=st.plan_key, plan_perm=st.plan_perm,
+            plan_bounds=st.plan_bounds,
             type_state=st.type_state,
         )
         if p > 1:

@@ -43,6 +43,30 @@ from ..config import RuntimeOptions
 from ..program import Program
 
 
+def layout_sizes(program: Program, opts: RuntimeOptions):
+    """Static per-shard sizes shared by build_step and init_state:
+    (e_out, bucket, n_delivery_entries).
+
+    e_out — outbox entries one shard can emit per tick;
+    bucket — per-destination all_to_all bucket (mesh only);
+    n_delivery_entries — rows in one shard's delivery list
+    (receiver-spill + host inject + incoming), which is also the length
+    of the cached delivery plan (see delivery.py)."""
+    e_out = sum(ch.local_capacity * ch.batch * ch.max_sends
+                for ch in program.device_cohorts)
+    s = opts.spill_cap
+    p = program.shards
+    if p > 1:
+        # Worst case one shard receives everything; keep buckets at
+        # outbox-size/shards ×4 (tunable; overflow is safe).
+        bucket = max(16, min(e_out + s, 4 * (e_out + s) // p))
+        incoming = p * bucket
+    else:
+        bucket = 0
+        incoming = s + e_out          # route-spill passthrough + outbox
+    return e_out, bucket, s + opts.inject_slots + incoming
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class RtState:
@@ -96,6 +120,16 @@ class RtState:
     #                              (0 = none; ≙ fork's pony_error_code)
     n_errors: jnp.ndarray     # [P] int32 — error_int events
 
+    # Cached delivery plan (see delivery.py): when consecutive ticks carry
+    # the same (target, level) key vector — any topology-stable traffic —
+    # the sort permutation and segment bounds are reused instead of
+    # re-sorted. The TPU analog of the reference's O(1) pointer-based
+    # mailbox push (messageq.c:102-160): the "pointer" is a delivery plan
+    # amortised across ticks.
+    plan_key: jnp.ndarray     # [P*E] int32, -1 = invalid (forces replan)
+    plan_perm: jnp.ndarray    # [P*E] int32 stable-sort permutation
+    plan_bounds: jnp.ndarray  # [P*(n_local+1)] int32 segment bounds
+
     # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
     # (leading axis shard-major; see Cohort.slot_to_col).
     type_state: Dict[str, Dict[str, jnp.ndarray]]
@@ -109,6 +143,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     w1 = 1 + opts.msg_words
     c = opts.mailbox_cap
     s = opts.spill_cap * p
+    _, _, n_entries = layout_sizes(program, opts)
     i32 = jnp.int32
 
     type_state: Dict[str, Dict[str, Any]] = {}
@@ -155,5 +190,8 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_collected=jnp.zeros((p,), i32),
         last_error=jnp.zeros((n,), i32),
         n_errors=jnp.zeros((p,), i32),
+        plan_key=jnp.full((p * n_entries,), -1, i32),
+        plan_perm=jnp.zeros((p * n_entries,), i32),
+        plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),
         type_state=type_state,
     )
