@@ -126,75 +126,91 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             lambda _: _compute_plan(key),
             operand=None)
 
-    kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
-    wds = words[perm]
-    ktc = jnp.minimum(kt, n - 1)
-    seg_start = bounds[:-1]                      # [n]
-    cnt = bounds[1:] - seg_start                 # [n] msgs per target
-    occ = tail - head
-    space = jnp.maximum(c - occ, 0)
-    acc = jnp.minimum(cnt, space)                # accepted per target
-    new_tail = tail + acc
-
-    # Dense ring rebuild: slot (tail+j)%cap ← sorted entry seg_start+j.
-    slots = jnp.arange(c, dtype=jnp.int32)[None, :]
-    rel = (slots - tail[:, None]) % c            # j for each ring slot
-    wmask = rel < acc[:, None]                   # this slot gets a message
-    src = jnp.minimum(seg_start[:, None] + rel, e - 1)
-    buf = jnp.where(wmask[:, :, None], wds[src], buf)
-
-    n_delivered = jnp.sum(acc)
-    nrej = jnp.sum(cnt - acc)
-    n_deadletter = jnp.sum(to_dead.astype(jnp.int32))
-    occ_after = new_tail - head
-
-    # --- pressure paths, traced under cond so the quiet steady state
-    # pays nothing (≙ mute bookkeeping only on actual overload).
     w1 = words.shape[1]
 
-    def pressure(_):
-        rank = jnp.arange(e, dtype=jnp.int32) - seg_start[ktc]
-        ok = kt < n
-        rej = ok & (rank >= acc[ktc])
-        perm2, vspill, _ = compact_mask(rej, spill_cap)
-        snd = sender[perm]
-        spill = Entries(
-            tgt=jnp.where(vspill, kt[perm2], -1),
-            sender=jnp.where(vspill, snd[perm2], -1),
-            words=jnp.where(vspill[:, None], wds[perm2], 0),
-        )
-        # Mute triggers (≙ actor.c:898-921 + mute rules actor.c:1171-1235):
-        # a valid send whose receiver rejected it or is now over the
-        # overload threshold mutes the sender — unless the sender is
-        # itself overloaded (the reference's !OVERLOADED/UNDER_PRESSURE
-        # guard, which prevents mute deadlocks among hot actors). Only
-        # senders resident on this shard can be muted here.
-        recv_hot = occ_after[ktc] > overload_occ
-        lsnd = snd - shard_base
-        sender_local = (lsnd >= 0) & (lsnd < n)
-        sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
-        sender_hot = occ_after[sc] > overload_occ
-        trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
-        mute_row = jnp.where(trig, sc, n)
-        newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
-            trig, mode="drop")
-        new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
-            jnp.where(trig, kt + shard_base, -1), mode="drop")
-        return spill, newly_muted, new_mute_ref
-
-    def quiet(_):
+    def _empty_spill():
         return (Entries(tgt=jnp.full((spill_cap,), -1, jnp.int32),
                         sender=jnp.full((spill_cap,), -1, jnp.int32),
                         words=jnp.zeros((spill_cap, w1), jnp.int32)),
                 jnp.zeros((n,), jnp.bool_),
                 jnp.full((n,), -1, jnp.int32))
 
-    any_pressure = (nrej > 0) | jnp.any(occ_after > overload_occ)
-    spill, newly_muted, new_mute_ref = lax.cond(
-        any_pressure, pressure, quiet, operand=None)
+    # Everything below only matters when at least one message exists this
+    # tick, so it all sits under one cond: an *idle* world's step touches
+    # no mailbox memory at all (≙ the fork's idle-cost fix is the reason
+    # it exists, README.md:8-10 — a waiting scheduler must cost ~nothing).
+    def with_msgs(_):
+        kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
+        wds = words[perm]
+        ktc = jnp.minimum(kt, n - 1)
+        seg_start = bounds[:-1]                  # [n]
+        cnt = bounds[1:] - seg_start             # [n] msgs per target
+        occ = tail - head
+        space = jnp.maximum(c - occ, 0)
+        acc = jnp.minimum(cnt, space)            # accepted per target
+        new_tail = tail + acc
 
+        # Dense ring rebuild: slot (tail+j)%cap ← sorted entry seg_start+j.
+        slots = jnp.arange(c, dtype=jnp.int32)[None, :]
+        rel = (slots - tail[:, None]) % c        # j for each ring slot
+        wmask = rel < acc[:, None]               # this slot gets a message
+        src = jnp.minimum(seg_start[:, None] + rel, e - 1)
+        buf2 = jnp.where(wmask[:, :, None], wds[src], buf)
+
+        n_delivered = jnp.sum(acc)
+        nrej = jnp.sum(cnt - acc)
+        occ_after = new_tail - head
+
+        # --- pressure paths, traced under a nested cond so the quiet
+        # busy state pays nothing (≙ mute bookkeeping only on overload).
+        def pressure(_):
+            rank = jnp.arange(e, dtype=jnp.int32) - seg_start[ktc]
+            ok = kt < n
+            rej = ok & (rank >= acc[ktc])
+            perm2, vspill, _ = compact_mask(rej, spill_cap)
+            snd = sender[perm]
+            spill = Entries(
+                tgt=jnp.where(vspill, kt[perm2], -1),
+                sender=jnp.where(vspill, snd[perm2], -1),
+                words=jnp.where(vspill[:, None], wds[perm2], 0),
+            )
+            # Mute triggers (≙ actor.c:898-921 + mute rules
+            # actor.c:1171-1235): a valid send whose receiver rejected it
+            # or is now over the overload threshold mutes the sender —
+            # unless the sender is itself overloaded (the reference's
+            # !OVERLOADED/UNDER_PRESSURE guard, which prevents mute
+            # deadlocks among hot actors). Only senders resident on this
+            # shard can be muted here.
+            recv_hot = occ_after[ktc] > overload_occ
+            lsnd = snd - shard_base
+            sender_local = (lsnd >= 0) & (lsnd < n)
+            sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
+            sender_hot = occ_after[sc] > overload_occ
+            trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
+            mute_row = jnp.where(trig, sc, n)
+            newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
+                trig, mode="drop")
+            new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
+                jnp.where(trig, kt + shard_base, -1), mode="drop")
+            return spill, newly_muted, new_mute_ref
+
+        any_pressure = (nrej > 0) | jnp.any(occ_after > overload_occ)
+        spill, newly_muted, new_mute_ref = lax.cond(
+            any_pressure, pressure, lambda _: _empty_spill(), operand=None)
+        return (buf2, new_tail, spill, newly_muted, new_mute_ref,
+                n_delivered, nrej)
+
+    def no_msgs(_):
+        spill, newly_muted, new_mute_ref = _empty_spill()
+        return (buf, tail, spill, newly_muted, new_mute_ref,
+                jnp.int32(0), jnp.int32(0))
+
+    (buf_out, new_tail, spill, newly_muted, new_mute_ref, n_delivered,
+     nrej) = lax.cond(jnp.any(valid), with_msgs, no_msgs, operand=None)
+
+    n_deadletter = jnp.sum(to_dead.astype(jnp.int32))
     return DeliveryResult(
-        buf=buf, tail=new_tail,
+        buf=buf_out, tail=new_tail,
         spill=spill, spill_count=jnp.minimum(nrej, spill_cap),
         spill_overflow=nrej > spill_cap,
         newly_muted=newly_muted, new_mute_ref=new_mute_ref,

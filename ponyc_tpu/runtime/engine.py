@@ -227,31 +227,60 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
                    runnable_rows, ids, resv):
-        n_run = jnp.where(runnable_rows,
-                          jnp.minimum(occ_rows, batch), 0)
-        k = jnp.arange(batch, dtype=jnp.int32)
-        idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
-        msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
-        valids = k[None, :] < n_run[:, None]
-        (stf, (stgt, swords), ef, ec, sfail, dstr, errs, nproc, nbad,
-         n_consumed, claims) = vfn(type_state_rows, msgs, valids, ids,
-                                   resv)
-        # Flatten the outbox: (actor, slot, send) order — exactly a
-        # sender's causal emission order.
         e = cohort.local_capacity * batch * ms
         sender = jnp.repeat(ids, batch * ms)
-        out = Entries(tgt=stgt.reshape(e),
-                      sender=sender,
-                      words=swords.reshape(e, -1))
-        any_exit = jnp.any(ef)
-        code = ec[jnp.argmax(ef)]
-        # claims: tuple aligned with spawn_sites, each [rows, batch, sites].
-        flat_claims = {t: c.reshape(-1)
-                       for (t, _), c in zip(spawn_sites, claims)}
-        return (stf, out, head_rows + n_consumed, any_exit, code,
-                jnp.sum(nproc), jnp.sum(nbad), flat_claims,
-                jnp.any(sfail), dstr if effects["destroy"] else None,
-                errs if effects["error"] else None)
+        rows = cohort.local_capacity
+        w1 = 1 + msg_words
+
+        def busy_fn(_):
+            n_run = jnp.where(runnable_rows,
+                              jnp.minimum(occ_rows, batch), 0)
+            k = jnp.arange(batch, dtype=jnp.int32)
+            idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
+            msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
+            valids = k[None, :] < n_run[:, None]
+            (stf, (stgt, swords), ef, ec, sfail, dstr, errs, nproc, nbad,
+             n_consumed, claims) = vfn(type_state_rows, msgs, valids, ids,
+                                       resv)
+            any_exit = jnp.any(ef)
+            code = ec[jnp.argmax(ef)]
+            errf, errc = errs
+            # claims: tuple aligned with spawn_sites, [rows, batch, sites].
+            return (stf, stgt.reshape(e), swords.reshape(e, w1),
+                    head_rows + n_consumed, any_exit, code,
+                    jnp.sum(nproc), jnp.sum(nbad),
+                    tuple(c.reshape(-1) for c in claims),
+                    jnp.any(sfail), dstr, errf, errc)
+
+        def idle_fn(_):
+            # ≙ the fork's whole point (README.md:8-10, scaling_sleep): a
+            # scheduler with no work must cost ~nothing. A cohort with no
+            # queued runnable messages skips gather/dispatch/outbox
+            # entirely — one reduction decides.
+            return (type_state_rows,
+                    jnp.full((e,), -1, jnp.int32),
+                    jnp.zeros((e, w1), jnp.int32),
+                    head_rows, jnp.bool_(False), jnp.int32(0),
+                    jnp.int32(0), jnp.int32(0),
+                    tuple(jnp.full((rows * batch * n,), -1, jnp.int32)
+                          for _, n in spawn_sites),
+                    jnp.bool_(False),
+                    jnp.zeros((rows,), jnp.bool_),
+                    jnp.zeros((rows,), jnp.bool_),
+                    jnp.zeros((rows,), jnp.int32))
+
+        busy = jnp.any(runnable_rows & (occ_rows > 0))
+        # (cond traces both branches here, so `effects` is fully
+        # populated by the time the lines below read it.)
+        (stf, out_tgt, out_words, new_head, any_exit, code, nproc, nbad,
+         claims_t, sfail, dstr, errf, errc) = lax.cond(
+            busy, busy_fn, idle_fn, operand=None)
+        out = Entries(tgt=out_tgt, sender=sender, words=out_words)
+        flat_claims = {t: c for (t, _), c in zip(spawn_sites, claims_t)}
+        return (stf, out, new_head, any_exit, code, nproc, nbad,
+                flat_claims, sfail,
+                dstr if effects["destroy"] else None,
+                (errf, errc) if effects["error"] else None)
 
     return run_cohort
 
@@ -383,19 +412,25 @@ def build_step(program: Program, opts: RuntimeOptions):
         dspill_pending = counts_by_key(
             jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
             dsp_valid.astype(jnp.int32), nl)
-        has_ref = st.mute_ref >= 0
-        lref = st.mute_ref - base
-        ref_local = (lref >= 0) & (lref < nl)
-        mr = jnp.minimum(jnp.maximum(lref, 0), nl - 1)
-        local_ok = (ref_local & (occ0[mr] <= opts.unmute_occ)
-                    & (dspill_pending[mr] == 0))
-        # Remote muting ref: release once this shard's route-spill drained
-        # (the local evidence of congestion is gone; receiver-side pressure
-        # will re-mute via routing if it persists).
-        remote_ok = (~ref_local) & (st.rspill_count[0] == 0)
-        release = st.muted & (~has_ref | local_ok | remote_ok)
-        muted = st.muted & ~release
-        mute_ref = jnp.where(release, -1, st.mute_ref)
+        def unmute_pass(_):
+            has_ref = st.mute_ref >= 0
+            lref = st.mute_ref - base
+            ref_local = (lref >= 0) & (lref < nl)
+            mr = jnp.minimum(jnp.maximum(lref, 0), nl - 1)
+            local_ok = (ref_local & (occ0[mr] <= opts.unmute_occ)
+                        & (dspill_pending[mr] == 0))
+            # Remote muting ref: release once this shard's route-spill
+            # drained (the local evidence of congestion is gone;
+            # receiver-side pressure will re-mute via routing if it
+            # persists).
+            remote_ok = (~ref_local) & (st.rspill_count[0] == 0)
+            release = st.muted & (~has_ref | local_ok | remote_ok)
+            return st.muted & ~release, jnp.where(release, -1, st.mute_ref)
+
+        # Nobody muted (the common case) → skip the pass entirely.
+        muted, mute_ref = lax.cond(
+            jnp.any(st.muted), unmute_pass,
+            lambda _: (st.muted, st.mute_ref), operand=None)
 
         # --- 1b. spawn reservations (≙ pony_create's slot allocation,
         # actor.c:688-734, done ahead of dispatch): per spawn-target
