@@ -51,6 +51,12 @@ class RuntimeOptions:
     spill_cap: int = 4096          # device overflow-spill entries (≙ the
     #   unbounded pool-backed queues of the reference; bounded here because
     #   XLA shapes are static — overflow beyond this raises)
+    mute_slots: int = 4            # muting-receiver refs tracked per sender
+    #   (≙ mutemap.c's receiver-set + actor.h mute counters: unmute only
+    #   when *every* tracked muting receiver recovers; refs hash into
+    #   ref%K slots, and a collision sets a sticky overflow bit that
+    #   defers release until the whole shard is quiet — conservative,
+    #   never an early unmute)
 
     # --- lifecycle / quiescence (≙ scheduler.c:303-480 CNF/ACK) ---
     quiesce_interval: int = 1      # host checks the device work-bit every

@@ -124,11 +124,10 @@ class Program:
         # Host cohorts last: their rows sit in a contiguous per-shard tail
         # range so delivery can classify "host-bound" with one compare
         # (≙ inject_main diverting use_main_thread actors, scheduler.c:179).
-        if self.shards > 1 and any(t.HOST for t, _ in self._declared):
-            raise NotImplementedError(
-                "HOST=True actor types are not yet supported on a "
-                "multi-shard mesh; keep host actors on a single-chip "
-                "runtime")
+        # On a mesh each shard carries its share of every host cohort's
+        # mailbox rows (shard-major slots, like device cohorts); the host
+        # driver drains them all at poll boundaries — the mesh analog of
+        # the main-thread scheduler (scheduler.c:179-190, 1030-1035).
         self._declared.sort(key=lambda tc: bool(tc[0].HOST))
         offset = 0
         for atype, cap in self._declared:

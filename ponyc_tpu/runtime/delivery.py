@@ -57,7 +57,10 @@ class DeliveryResult(NamedTuple):
     spill_count: jnp.ndarray   # [] int32
     spill_overflow: jnp.ndarray
     newly_muted: jnp.ndarray   # [n_local] bool (local senders only)
-    new_mute_ref: jnp.ndarray  # [n_local] int32 global ref (-1 none)
+    new_mute_refs: jnp.ndarray  # [n_local, K] global refs slotted by
+    #                               ref % K (-1 = empty)
+    new_mute_ovf: jnp.ndarray  # [n_local] bool — distinct refs collided
+    #                               in one slot this tick
     n_delivered: jnp.ndarray
     n_rejected: jnp.ndarray
     n_deadletter: jnp.ndarray
@@ -66,9 +69,29 @@ class DeliveryResult(NamedTuple):
     plan_bounds: jnp.ndarray   # [n_local+1] cached segment bounds
 
 
+def mute_ref_slots(trig, mute_row, refs, *, n: int, k: int):
+    """Scatter triggered (sender-row, receiver-ref) mute pairs into the
+    per-sender K-slot ref table (slot = ref % K). Returns (refs [n, K],
+    ovf [n]) where ovf marks rows where two *distinct* refs collided in
+    one slot this tick (≙ a mutemap set outgrowing its fixed width)."""
+    big = jnp.int32(2**31 - 1)
+    slot = jnp.where(trig, refs % k, 0)
+    row = jnp.where(trig, mute_row, n)
+    rmax = jnp.full((n, k), -1, jnp.int32).at[row, slot].max(
+        jnp.where(trig, refs, -1), mode="drop")
+    rmin = jnp.full((n, k), big, jnp.int32).at[row, slot].min(
+        jnp.where(trig, refs, big), mode="drop")
+    ovf = jnp.any((rmax >= 0) & (rmin != rmax), axis=1)
+    return rmax, ovf
+
+
+def empty_mute_slots(n: int, k: int):
+    return jnp.full((n, k), -1, jnp.int32), jnp.zeros((n,), jnp.bool_)
+
+
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
-            shard_base, level=None, n_levels: int = 1,
+            shard_base, mute_slots: int = 4, level=None, n_levels: int = 1,
             plan=None) -> DeliveryResult:
     """`level` ([E] int32, 0 = most urgent) folds the fork's actor
     *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
@@ -129,11 +152,11 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     w1 = words.shape[1]
 
     def _empty_spill():
+        refs, ovf = empty_mute_slots(n, mute_slots)
         return (Entries(tgt=jnp.full((spill_cap,), -1, jnp.int32),
                         sender=jnp.full((spill_cap,), -1, jnp.int32),
                         words=jnp.zeros((spill_cap, w1), jnp.int32)),
-                jnp.zeros((n,), jnp.bool_),
-                jnp.full((n,), -1, jnp.int32))
+                jnp.zeros((n,), jnp.bool_), refs, ovf)
 
     # Everything below only matters when at least one message exists this
     # tick, so it all sits under one cond: an *idle* world's step touches
@@ -190,22 +213,22 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mute_row = jnp.where(trig, sc, n)
             newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(
                 trig, mode="drop")
-            new_mute_ref = jnp.full((n,), -1, jnp.int32).at[mute_row].max(
-                jnp.where(trig, kt + shard_base, -1), mode="drop")
-            return spill, newly_muted, new_mute_ref
+            refs, ovf = mute_ref_slots(trig, mute_row, kt + shard_base,
+                                       n=n, k=mute_slots)
+            return spill, newly_muted, refs, ovf
 
         any_pressure = (nrej > 0) | jnp.any(occ_after > overload_occ)
-        spill, newly_muted, new_mute_ref = lax.cond(
+        spill, newly_muted, new_refs, new_ovf = lax.cond(
             any_pressure, pressure, lambda _: _empty_spill(), operand=None)
-        return (buf2, new_tail, spill, newly_muted, new_mute_ref,
+        return (buf2, new_tail, spill, newly_muted, new_refs, new_ovf,
                 n_delivered, nrej)
 
     def no_msgs(_):
-        spill, newly_muted, new_mute_ref = _empty_spill()
-        return (buf, tail, spill, newly_muted, new_mute_ref,
+        spill, newly_muted, new_refs, new_ovf = _empty_spill()
+        return (buf, tail, spill, newly_muted, new_refs, new_ovf,
                 jnp.int32(0), jnp.int32(0))
 
-    (buf_out, new_tail, spill, newly_muted, new_mute_ref, n_delivered,
+    (buf_out, new_tail, spill, newly_muted, new_refs, new_ovf, n_delivered,
      nrej) = lax.cond(jnp.any(valid), with_msgs, no_msgs, operand=None)
 
     n_deadletter = jnp.sum(to_dead.astype(jnp.int32))
@@ -213,7 +236,8 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
         buf=buf_out, tail=new_tail,
         spill=spill, spill_count=jnp.minimum(nrej, spill_cap),
         spill_overflow=nrej > spill_cap,
-        newly_muted=newly_muted, new_mute_ref=new_mute_ref,
+        newly_muted=newly_muted, new_mute_refs=new_refs,
+        new_mute_ovf=new_ovf,
         n_delivered=n_delivered,
         n_rejected=nrej,
         n_deadletter=n_deadletter,

@@ -44,7 +44,7 @@ from ..config import RuntimeOptions
 from ..ops import pack
 from ..ops.segment import compact_mask, counts_by_key, stable_sort_by
 from ..program import Cohort, Program
-from .delivery import Entries, deliver
+from .delivery import (Entries, deliver, empty_mute_slots, mute_ref_slots)
 from .state import RtState, layout_sizes
 
 
@@ -286,7 +286,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
 
 
 def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
-           rspill_cap: int, overload_occ, head, tail, shard_base):
+           rspill_cap: int, overload_occ, head, tail, shard_base,
+           mute_slots: int):
     """Mesh routing: pack entries into per-destination-shard buckets and
     exchange them with one all_to_all over the actor axis (ICI).
 
@@ -352,23 +353,23 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
         mute_row = jnp.where(trig, sc, n_local)
         newly_muted = jnp.zeros((n_local,), jnp.bool_).at[mute_row].max(
             trig, mode="drop")
-        new_ref = jnp.full((n_local,), -1, jnp.int32).at[mute_row].max(
-            jnp.where(trig, ts, -1), mode="drop")
-        return spill, newly_muted, new_ref
+        refs, ovf = mute_ref_slots(trig, mute_row, ts, n=n_local,
+                                   k=mute_slots)
+        return spill, newly_muted, refs, ovf
 
     def quiet(_):
+        refs, ovf = empty_mute_slots(n_local, mute_slots)
         return (Entries(tgt=jnp.full((rspill_cap,), -1, jnp.int32),
                         sender=jnp.full((rspill_cap,), -1, jnp.int32),
                         words=jnp.zeros((rspill_cap, w1), jnp.int32)),
-                jnp.zeros((n_local,), jnp.bool_),
-                jnp.full((n_local,), -1, jnp.int32))
+                jnp.zeros((n_local,), jnp.bool_), refs, ovf)
 
-    new_rspill, newly_muted, new_ref = lax.cond(
+    new_rspill, newly_muted, new_refs, new_ovf = lax.cond(
         nrej > 0, pressure, quiet, operand=None)
 
     received = Entries(tgt=rt, sender=rs, words=rw)
     return (received, new_rspill, jnp.minimum(nrej, rspill_cap),
-            nrej > rspill_cap, newly_muted, new_ref)
+            nrej > rspill_cap, newly_muted, new_refs, new_ovf)
 
 
 def build_step(program: Program, opts: RuntimeOptions):
@@ -413,24 +414,36 @@ def build_step(program: Program, opts: RuntimeOptions):
             jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
             dsp_valid.astype(jnp.int32), nl)
         def unmute_pass(_):
-            has_ref = st.mute_ref >= 0
-            lref = st.mute_ref - base
+            # ≙ ponyint_sched_unmute_senders walking the mutemap
+            # receiver-set (scheduler.c:1552-1635): a sender releases only
+            # when EVERY tracked muting receiver has recovered.
+            refs = st.mute_refs                       # [nl, K]
+            has = refs >= 0
+            lref = refs - base
             ref_local = (lref >= 0) & (lref < nl)
             mr = jnp.minimum(jnp.maximum(lref, 0), nl - 1)
-            local_ok = (ref_local & (occ0[mr] <= opts.unmute_occ)
+            local_ok = (has & ref_local & (occ0[mr] <= opts.unmute_occ)
                         & (dspill_pending[mr] == 0))
             # Remote muting ref: release once this shard's route-spill
             # drained (the local evidence of congestion is gone;
             # receiver-side pressure will re-mute via routing if it
             # persists).
-            remote_ok = (~ref_local) & (st.rspill_count[0] == 0)
-            release = st.muted & (~has_ref | local_ok | remote_ok)
-            return st.muted & ~release, jnp.where(release, -1, st.mute_ref)
+            remote_ok = has & ~ref_local & (st.rspill_count[0] == 0)
+            slot_ok = ~has | local_ok | remote_ok
+            all_ok = jnp.all(slot_ok, axis=1)
+            # Overflowed ref sets (more distinct muters than slots) defer
+            # to a shard-wide quiet condition — conservative, never early.
+            shard_quiet = (jnp.max(occ0) <= opts.unmute_occ) \
+                & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0)
+            release = st.muted & all_ok & (~st.mute_ovf | shard_quiet)
+            return (st.muted & ~release,
+                    jnp.where(release[:, None], -1, refs),
+                    st.mute_ovf & ~release)
 
         # Nobody muted (the common case) → skip the pass entirely.
-        muted, mute_ref = lax.cond(
+        muted, mute_refs, mute_ovf = lax.cond(
             jnp.any(st.muted), unmute_pass,
-            lambda _: (st.muted, st.mute_ref), operand=None)
+            lambda _: (st.muted, st.mute_refs, st.mute_ovf), operand=None)
 
         # --- 1b. spawn reservations (≙ pony_create's slot allocation,
         # actor.c:688-734, done ahead of dispatch): per spawn-target
@@ -563,13 +576,14 @@ def build_step(program: Program, opts: RuntimeOptions):
                                   [o.words for o in out_entries]),
         )
         route_muted = jnp.zeros((nl,), jnp.bool_)
-        route_ref = jnp.full((nl,), -1, jnp.int32)
+        route_refs, route_ovf = empty_mute_slots(nl, opts.mute_slots)
         if p > 1:
             (incoming, new_rspill, rsp_count, rsp_over, route_muted,
-             route_ref) = _route(
+             route_refs, route_ovf) = _route(
                 out_cat, shards=p, n_local=nl, bucket=bucket,
                 rspill_cap=s_cap, overload_occ=opts.overload_occ,
-                head=new_head, tail=tail0, shard_base=base)
+                head=new_head, tail=tail0, shard_base=base,
+                mute_slots=opts.mute_slots)
             incoming = incoming._replace(
                 tgt=jnp.where(incoming.tgt >= 0, incoming.tgt - base, -1))
         else:
@@ -607,6 +621,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         res = deliver(st.buf, new_head, tail0, alive, all_e,
                       n_local=nl, mailbox_cap=c, spill_cap=s_cap,
                       overload_occ=opts.overload_occ, shard_base=base,
+                      mute_slots=opts.mute_slots,
                       level=lvl_all, n_levels=n_levels,
                       plan=(st.plan_key, st.plan_perm, st.plan_bounds))
 
@@ -640,16 +655,30 @@ def build_step(program: Program, opts: RuntimeOptions):
             new_head = new_head.at[rows].set(
                 jnp.take(new_tail, jnp.minimum(rows, nl - 1)), mode="drop")
             muted = muted.at[rows].set(False, mode="drop")
-            mute_ref = mute_ref.at[rows].set(-1, mode="drop")
+            mute_refs = mute_refs.at[rows].set(-1, mode="drop")
+            mute_ovf = mute_ovf.at[rows].set(False, mode="drop")
             pinned = pinned.at[rows].set(False, mode="drop")
             n_destroyed = n_destroyed + jnp.sum(dstr.astype(jnp.int32))
 
-        # --- 5. mute bookkeeping (≙ ponyint_mute_actor, actor.c:1171-1207).
+        # --- 5. mute bookkeeping (≙ ponyint_mute_actor + mutemap insert,
+        # actor.c:1171-1207, mutemap.c): this tick's muting refs from
+        # delivery and routing MERGE into each sender's slot table (a
+        # re-muted sender keeps its older muters); a slot collision
+        # between distinct refs sets the sticky overflow bit.
+        def _merge_slots(a, b):
+            both = (a >= 0) & (b >= 0)
+            m = jnp.where(a < 0, b, jnp.where(b < 0, a, jnp.maximum(a, b)))
+            return m, jnp.any(both & (a != b), axis=1)
+
         newly = (res.newly_muted | route_muted) & alive
-        new_ref = jnp.maximum(res.new_mute_ref, route_ref)
+        inc_refs, c1 = _merge_slots(res.new_mute_refs, route_refs)
+        merged_refs, c2 = _merge_slots(mute_refs, inc_refs)
         became_muted = newly & ~muted
         muted2 = muted | newly
-        mute_ref2 = jnp.where(newly, new_ref, mute_ref)
+        mute_refs2 = jnp.where(newly[:, None], merged_refs, mute_refs)
+        mute_ovf2 = jnp.where(
+            newly, mute_ovf | res.new_mute_ovf | route_ovf | c1 | c2,
+            mute_ovf)
 
         occ_after = new_tail - new_head
         nrej_new = st.n_rejected[0] + res.n_rejected
@@ -679,6 +708,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                 spawn_fail.astype(jnp.int32), "actors") > 0
             device_pending = lax.psum(
                 local_pending.astype(jnp.int32), "actors") > 0
+            host_pending = lax.psum(
+                host_pending.astype(jnp.int32), "actors") > 0
             exit_any = lax.psum(exit_f.astype(jnp.int32), "actors") > 0
             exit_code_all = lax.pmax(
                 jnp.where(exit_f, exit_c, jnp.int32(-2**31)), "actors")
@@ -711,7 +742,8 @@ def build_step(program: Program, opts: RuntimeOptions):
 
         st2 = RtState(
             buf=res.buf, head=new_head, tail=new_tail,
-            alive=alive, muted=muted2, mute_ref=mute_ref2, pinned=pinned,
+            alive=alive, muted=muted2, mute_refs=mute_refs2,
+            mute_ovf=mute_ovf2, pinned=pinned,
             dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
             dspill_words=res.spill.words,
             dspill_count=vec(res.spill_count),

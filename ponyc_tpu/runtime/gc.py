@@ -190,7 +190,8 @@ def build_gc(program: Program, opts: RuntimeOptions):
             tail=st.tail,
             alive=st.alive & ~dead,
             muted=st.muted & ~dead,
-            mute_ref=jnp.where(dead, -1, st.mute_ref),
+            mute_refs=jnp.where(dead[:, None], -1, st.mute_refs),
+            mute_ovf=st.mute_ovf & ~dead,
             pinned=st.pinned & ~dead,
             dspill_tgt=st.dspill_tgt, dspill_sender=st.dspill_sender,
             dspill_words=st.dspill_words, dspill_count=st.dspill_count,

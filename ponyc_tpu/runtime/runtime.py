@@ -505,23 +505,38 @@ class Runtime:
         from ..files import FilesAuth
         return FilesAuth(FilesAuth._token)
 
-    # ---- host-cohort dispatch (≙ main-thread scheduler path) ----
+    # ---- host-cohort dispatch (≙ main-thread scheduler path; on a mesh,
+    # each shard's host-row tail range is gathered and drained here — the
+    # multi-chip analog of inject_main, scheduler.c:179-190) ----
+    @property
+    def _host_rows(self) -> np.ndarray:
+        """Global ids of all host-cohort mailbox rows (every shard's tail
+        range), cached after start()."""
+        rows = getattr(self, "_host_rows_cache", None)
+        if rows is None:
+            fh, nl = self.program.first_host_row, self.program.n_local
+            p = self.program.shards
+            rows = np.concatenate(
+                [s * nl + np.arange(fh, nl) for s in range(p)]) \
+                if fh < nl else np.zeros((0,), np.int64)
+            self._host_rows_cache = rows
+        return rows
+
     def _drain_host(self) -> bool:
-        # Host cohorts only exist on single-shard runtimes (P=1), where
-        # local row == global id.
-        fh, n = self.program.first_host_row, self.program.total
-        if fh >= n:
+        rows = self._host_rows
+        if rows.size == 0:
             return False
-        head = np.asarray(self.state.head[fh:])
-        tail = np.asarray(self.state.tail[fh:])
+        rows_j = jnp.asarray(rows)
+        head = np.asarray(self.state.head[rows_j])
+        tail = np.asarray(self.state.tail[rows_j])
         pending = tail - head
         if not pending.any():
             return False
-        buf = np.asarray(self.state.buf[fh:])
+        buf = np.asarray(self.state.buf[rows_j])
         c = self.opts.mailbox_cap
         new_head = head.copy()
         for i in np.nonzero(pending)[0]:
-            aid = fh + int(i)
+            aid = int(rows[int(i)])
             cohort = self.program.cohort_of(aid)
             consumed = 0
             for k in range(int(pending[i])):
@@ -676,7 +691,7 @@ class Runtime:
         alive = np.asarray(st.alive)
         muted = np.asarray(st.muted)
         assert not (muted & ~alive).any(), "dead actor still muted"
-        assert (np.asarray(st.mute_ref)[~muted] == -1).all(), \
+        assert (np.asarray(st.mute_refs)[~muted] == -1).all(), \
             "unmuted actor holds a mute ref"
         dead_occ = occ[~alive]
         assert (dead_occ == 0).all(), "dead actor with queued messages"

@@ -80,8 +80,15 @@ class RtState:
     # Per-actor scheduling flags (≙ actor.h:59-69 flag bits).
     alive: jnp.ndarray        # [N] bool — slot occupied (≙ !PENDINGDESTROY)
     muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
-    mute_ref: jnp.ndarray     # [N] int32 — global id of the muting
-    #                              receiver (may be off-shard); -1 = none
+    mute_refs: jnp.ndarray    # [N, K] int32 — global ids of the muting
+    #                              receivers (possibly off-shard), slotted
+    #                              by ref % K; -1 = empty slot. ≙ the
+    #                              mutemap receiver-set per sender
+    #                              (mutemap.c; scheduler.c:1478-1635):
+    #                              release only when all recover.
+    mute_ovf: jnp.ndarray     # [N] bool — more distinct muters than slots
+    #                              (hash collision); release deferred until
+    #                              the shard is globally quiet
     pinned: jnp.ndarray       # [N] bool — host holds a ref (GC root,
     #                              ≙ ORCA external rc; see runtime/gc.py)
 
@@ -164,7 +171,8 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         tail=jnp.zeros((n,), i32),
         alive=jnp.zeros((n,), jnp.bool_),
         muted=jnp.zeros((n,), jnp.bool_),
-        mute_ref=jnp.full((n,), -1, i32),
+        mute_refs=jnp.full((n, opts.mute_slots), -1, i32),
+        mute_ovf=jnp.zeros((n,), jnp.bool_),
         pinned=jnp.zeros((n,), jnp.bool_),
         dspill_tgt=jnp.full((s,), -1, i32),
         dspill_sender=jnp.full((s,), -1, i32),
