@@ -569,7 +569,7 @@ class Runtime:
                     break
             new_head[i] = head[i] + consumed
         self.state = self._replace(
-            head=self.state.head.at[fh:].set(jnp.asarray(new_head)))
+            head=self.state.head.at[rows_j].set(jnp.asarray(new_head)))
         return True
 
     # ---- the run loop (≙ pony_start → scheduler run → quiescence) ----

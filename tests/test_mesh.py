@@ -2,6 +2,8 @@
 multi-node test layer called out in SURVEY.md §4: JAX CPU devices are the
 "fake cluster")."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -61,6 +63,46 @@ def test_fanout_across_shards_and_counters():
     assert st["total"].sum() == sum(10 * (i + 1) for i in range(4)) * 2 + 4
     assert rt.totals["processed"] == 12  # 4 go + 8 recv
     assert rt.totals["delivered"] == 12
+
+
+def test_host_drain_across_shards():
+    # Host-cohort rows live at each shard's tail range — NOT a suffix of the
+    # global head array. This drains host actors on a 4-shard mesh and then
+    # re-runs, which fails if _drain_host writes heads at the wrong rows
+    # (regression: round-2 `.at[fh:]` bug, and the `fh` NameError).
+    @actor
+    class DevSrc:
+        out: Ref
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st, n: I32):
+            self.send(st["out"], HostSink.recv, n)
+            return st
+
+    @actor
+    class HostSink:
+        HOST = True
+
+        @behaviour
+        def recv(self, st, v: I32):
+            st = dict(st)
+            st["got"] = st.get("got", 0) + int(v)
+            return st
+
+    opts = dataclasses.replace(MESH_OPTS, msg_words=2)
+    rt = Runtime(opts)
+    rt.declare(DevSrc, 8).declare(HostSink, 8)
+    rt.start()
+    sinks = rt.spawn_many(HostSink, 8)
+    srcs = rt.spawn_many(DevSrc, 8, out=sinks)
+    for rnd in range(3):  # repeated drains: stale heads double-deliver
+        for i, s in enumerate(srcs):
+            rt.send(int(s), DevSrc.go, 10 * (i + 1))
+        rt.run(max_steps=40)
+    total = sum(rt.state_of(int(h)).get("got", 0) for h in sinks)
+    assert total == 3 * sum(10 * (i + 1) for i in range(8))
+    assert rt.totals["badmsg"] == 0
 
 
 def test_gups_across_shards():
