@@ -59,8 +59,11 @@ class RuntimeOptions:
     #   never an early unmute)
 
     # --- lifecycle / quiescence (≙ scheduler.c:303-480 CNF/ACK) ---
-    quiesce_interval: int = 1      # host checks the device work-bit every
-    #   N steps (1 = every step; raise to amortise device→host latency)
+    quiesce_interval: int = 64     # max ticks fused into one device
+    #   dispatch (engine.build_multi_step); the window self-terminates on
+    #   host work / exit / fatal flags, so this bounds only how long the
+    #   device may run *uninterrupted* — raise to amortise dispatch
+    #   overhead, lower to tighten max_steps granularity
     cd_interval: int = 128         # steps between cycle-detector scans
     #   (≙ --ponycdinterval default 100ms, start.c:206)
     noblock: bool = False          # ≙ --ponynoblock: disable cycle detection

@@ -787,33 +787,98 @@ def build_step(program: Program, opts: RuntimeOptions):
     return local_step
 
 
-def jit_step(program: Program, opts: RuntimeOptions, mesh=None):
-    """Jit the step; with a mesh, wrap in shard_map over the 'actors' axis.
+def build_multi_step(program: Program, opts: RuntimeOptions):
+    """Fuse up to `limit` scheduler ticks into ONE device dispatch.
+
+    ≙ the reference amortising scheduler-queue traffic by letting an actor
+    drain up to `batch` messages per visit (actor.c:20): here the *host*
+    is the expensive queue hop — each jitted call costs a fixed dispatch/
+    RPC overhead that dwarfs a tick's compute (the round-2 flat 60ms/tick)
+    — so one call advances many ticks under `lax.while_loop`.
+
+    The window ends early the moment the host must act: a host-cohort
+    mailbox became non-empty (main-thread actors, scheduler.c:179-190),
+    a behaviour exited, a fatal flag rose, or the device quiesced. Host
+    reaction latency therefore stays one tick, exactly as unfused.
+
+    Injections land on the first tick only (the host refills next window).
+    Returns (state, last_aux, ticks_run).
+    """
+    step = build_step(program, opts)
+
+    def multi(st: RtState, inject_tgt, inject_words, limit):
+        def cond(carry):
+            _st, aux, i = carry
+            go = (aux.device_pending & ~aux.host_pending & ~aux.exit_flag
+                  & ~aux.spill_overflow & ~aux.spawn_fail)
+            return (i == 0) | ((i < limit) & go)
+
+        def body(carry):
+            s, _aux, i = carry
+            first = i == 0
+            it = jnp.where(first, inject_tgt, jnp.int32(-1))
+            iw = jnp.where(first, inject_words, jnp.int32(0))
+            s2, aux2 = step(s, it, iw)
+            return (s2, aux2, i + 1)
+
+        i32, b = jnp.int32, jnp.bool_
+        aux0 = StepAux(
+            device_pending=b(True), host_pending=b(False),
+            exit_flag=b(False), exit_code=i32(0),
+            spill_overflow=b(False), spawn_fail=b(False),
+            n_processed=i32(0), n_delivered=i32(0),
+            occ_sum=i32(0), occ_max=i32(0),
+            n_muted_now=i32(0), n_overloaded_now=i32(0),
+            n_rejected=i32(0), n_badmsg=i32(0),
+            n_deadletter=i32(0), n_mutes=i32(0))
+        stf, auxf, k = lax.while_loop(cond, body, (st, aux0, jnp.int32(0)))
+        return stf, auxf, k
+
+    return multi
+
+
+def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
+                   n_extra: int):
+    """Jit `fn(state, inject_tgt, inject_words, *extras) → (state, aux,
+    *outs)` where len(outs) == n_extra; with a mesh, shard_map over the
+    'actors' axis first. State is sharded and donated; injections, extras
+    and aux are replicated (aux values are each tick's psum votes,
+    identical on every shard).
 
     ≙ ponyint_sched_start picking how many schedulers run
     (scheduler.c:1273-1309) — except "schedulers" are mesh shards and the
     assignment is static.
     """
-    step = build_step(program, opts)
     if program.shards == 1:
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(fn, donate_argnums=(0,))
 
     from jax.sharding import PartitionSpec as P
     assert mesh is not None, "sharded program needs a mesh"
     sharded = P("actors")
     repl = P()
-
-    def spec_of_state(_):
-        return sharded
-
-    state_spec = jax.tree.map(spec_of_state, _state_structure(program, opts))
+    state_spec = jax.tree.map(lambda _: sharded,
+                              _state_structure(program, opts))
     aux_spec = StepAux(*([repl] * len(StepAux._fields)))
     mapped = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(state_spec, repl, repl),
-        out_specs=(state_spec, aux_spec),
+        fn, mesh=mesh,
+        in_specs=(state_spec, repl, repl) + (repl,) * n_extra,
+        out_specs=(state_spec, aux_spec) + (repl,) * n_extra,
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+def jit_multi_step(program: Program, opts: RuntimeOptions, mesh=None):
+    """Jit the fused window (extra replicated input: tick limit; extra
+    replicated output: ticks run — so the while condition and the host's
+    step accounting are shard-uniform)."""
+    return _jit_over_mesh(build_multi_step(program, opts), program, opts,
+                          mesh, n_extra=1)
+
+
+def jit_step(program: Program, opts: RuntimeOptions, mesh=None):
+    """Jit one tick (see _jit_over_mesh for the mesh wrapping)."""
+    return _jit_over_mesh(build_step(program, opts), program, opts, mesh,
+                          n_extra=0)
 
 
 def _state_structure(program, opts):

@@ -8,12 +8,15 @@ program's code) plus the host side of actor creation
 (pony_create, actor/actor.c:688-734) and external sends (pony_sendv from
 non-actor context).
 
-The host loop is deliberately thin: it dispatches `quiesce_interval` jitted
-steps at a time (XLA runs them asynchronously), then reads back a handful
-of scalars to decide termination — the TPU analog of the CNF/ACK quiescence
-vote (scheduler.c:303-480). Host-resident actors (HOST=True types — the
-main-thread/ASIO-side actors of the reference, scheduler.c:179-190,
-asio/asio.c) are drained at those same boundaries.
+The host loop is deliberately thin: it issues ONE fused device dispatch
+per iteration (engine.build_multi_step — a lax.while_loop advancing up to
+`quiesce_interval` ticks that self-terminates the moment host attention
+is needed), then reads back a handful of scalars to decide termination —
+the TPU analog of the CNF/ACK quiescence vote (scheduler.c:303-480).
+Host-resident actors (HOST=True types — the main-thread/ASIO-side actors
+of the reference, scheduler.c:179-190, asio/asio.c) are drained at those
+window boundaries; the early window stop keeps their reaction latency at
+one tick, as if steps were dispatched singly.
 """
 
 from __future__ import annotations
@@ -175,6 +178,8 @@ class Runtime:
         else:
             self.mesh = None
         self._step = engine.jit_step(self.program, self.opts, self.mesh)
+        self._multi = engine.jit_multi_step(self.program, self.opts,
+                                            self.mesh)
         w1 = 1 + self.opts.msg_words
         k = self.opts.inject_slots
         self._empty_inject = (jnp.full((k,), -1, jnp.int32),
@@ -586,13 +591,20 @@ class Runtime:
         idle_polls = 0
         steps_this_run = 0
         while True:
-            aux = None
-            for _ in range(qi):
-                inj = self._drain_inject()
-                self.state, aux = self._step(self.state, *inj)
-                self.steps_run += 1
-                steps_this_run += 1
-            a = jax.device_get(aux)
+            # One fused device dispatch advances up to `budget` ticks
+            # (engine.build_multi_step); the window self-terminates the
+            # tick host attention is needed, so host latency matches the
+            # old one-step-per-dispatch loop.
+            budget = qi
+            if max_steps is not None:
+                budget = min(budget, max_steps - steps_this_run)
+            inj = self._drain_inject()
+            self.state, aux, kdev = self._multi(
+                self.state, *inj, jnp.int32(max(1, budget)))
+            k, a = jax.device_get((kdev, aux))
+            k = int(k)
+            self.steps_run += k
+            steps_this_run += k
             if self.opts.debug_checks:
                 self.check_invariants()
             # aux counters are cumulative int32; accumulate mod-2^32 deltas
