@@ -4,10 +4,21 @@ the reference's packages/ tree (SURVEY.md §2.3).
 The reference ships ~31 Pony packages. Their capabilities map here as:
 
   builtin            → the core framework (api/runtime/engine)
-  collections, math,
-  itertools, format  → Python builtins / numpy / jax.numpy (the host
-                       language already provides them; device-side state
-                       is fixed-width columns by design)
+  collections        → stdlib.collections (Flags/Range/heaps/RingBuffer/
+                       Sort/Reverse/List) + stdlib.persistent
+                       (HAMT Map, trie Vec, cons List, Set)
+  json               → stdlib.json (recursive-descent JsonDoc with
+                       line-reported errors)
+  cli                → stdlib.cli (CommandSpec/OptionSpec/ArgSpec typed
+                       parser with sub-commands, help, env fallback)
+  buffered           → stdlib.buffered (Reader/Writer chunked codecs)
+  encode/base64      → stdlib.encode (configurable-alphabet Base64)
+  format             → stdlib.format (Format int/float/string specs)
+  itertools          → stdlib.itertools (Iter combinators)
+  ini                → stdlib.ini (streaming notify parser + IniMap)
+  term               → stdlib.term (ANSI codes)
+  strings            → stdlib.strings (CommonPrefix)
+  math               → stdlib.math (Fibonacci)
   net                → ponyc_tpu.net (native socket layer underneath)
   files              → ponyc_tpu.files (capability-checked)
   process            → ponyc_tpu.process
@@ -24,11 +35,18 @@ The reference ships ~31 Pony packages. Their capabilities map here as:
   ponytest           → ponyc_tpu.testing
   ponybench          → ponyc_tpu.benching
   signals            → bridge.signal / bridge.sigterm_dump
-  cli/options        → config.strip_runtime_flags + argparse (host)
-  buffered, encode,
-  ini, json, strings → Python stdlib equivalents (host-side text/bytes)
+  options            → config.strip_runtime_flags (runtime flags) +
+                       stdlib.cli (application flags)
   bureaucracy        → stdlib.promises.Custodian
   capsicum           → files.FilesAuth capability chain
+  debug              → stdlib.logger + analysis SIGTERM dumps
+  assert             → ponyc_tpu.testing asserts (host) +
+                       config.debug_checks invariants (device)
+  builtin_test,
+  stdlib/_test       → tests/ (the aggregated suite IS the stdlib test
+                       binary; conftest runs every package's tests)
 """
 
-from . import logger, promises, random, timers  # noqa: F401
+from . import (buffered, cli, collections, encode, format, ini,  # noqa
+               itertools, json, logger, math, persistent, promises,
+               random, strings, term, timers)  # noqa: F401

@@ -1,0 +1,105 @@
+"""ANSI terminal support — ≙ the reference's `packages/term/`
+(ansi.pony codes; readline.pony's line editing is host-side input and
+maps to Python's input()/readline, documented divergence).
+
+ANSI is a primitive namespace of escape-code constructors, exactly the
+reference's surface: colors, bright variants, bold/underline/blink/
+reverse, reset, cursor movement, erase, and terminal size.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Tuple
+
+__all__ = ["ANSI"]
+
+_ESC = "\x1b["
+
+
+class ANSI:
+    """≙ ansi.pony ANSI primitive."""
+
+    @staticmethod
+    def up(n: int = 1) -> str:
+        return f"{_ESC}{n}A" if n else ""
+
+    @staticmethod
+    def down(n: int = 1) -> str:
+        return f"{_ESC}{n}B" if n else ""
+
+    @staticmethod
+    def right(n: int = 1) -> str:
+        return f"{_ESC}{n}C" if n else ""
+
+    @staticmethod
+    def left(n: int = 1) -> str:
+        return f"{_ESC}{n}D" if n else ""
+
+    @staticmethod
+    def cursor(x: int = 0, y: int = 0) -> str:
+        return f"{_ESC}{y};{x}H"
+
+    @staticmethod
+    def clear() -> str:
+        return f"{_ESC}2J"
+
+    @staticmethod
+    def erase() -> str:
+        """Erase to the left of the cursor (≙ ansi.pony erase)."""
+        return f"{_ESC}1K"
+
+    @staticmethod
+    def reset() -> str:
+        return f"{_ESC}0m"
+
+    @staticmethod
+    def bold(state: bool = True) -> str:
+        return f"{_ESC}1m" if state else f"{_ESC}22m"
+
+    @staticmethod
+    def underline(state: bool = True) -> str:
+        return f"{_ESC}4m" if state else f"{_ESC}24m"
+
+    @staticmethod
+    def blink(state: bool = True) -> str:
+        return f"{_ESC}5m" if state else f"{_ESC}25m"
+
+    @staticmethod
+    def reverse(state: bool = True) -> str:
+        return f"{_ESC}7m" if state else f"{_ESC}27m"
+
+    @staticmethod
+    def size() -> Tuple[int, int]:
+        """(rows, columns), env-overridable (≙ ansi.pony size)."""
+        try:
+            cols = int(os.environ.get("COLUMNS", ""))
+            rows = int(os.environ.get("LINES", ""))
+            return rows, cols
+        except ValueError:
+            ts = shutil.get_terminal_size()
+            return ts.lines, ts.columns
+
+
+def _add_colors():
+    base = {"black": 0, "red": 1, "green": 2, "yellow": 3, "blue": 4,
+            "magenta": 5, "cyan": 6, "white": 7, "grey": None}
+
+    for name, idx in base.items():
+        if name == "grey":
+            fg, bg = f"{_ESC}90m", f"{_ESC}100m"
+        else:
+            fg, bg = f"{_ESC}{30 + idx}m", f"{_ESC}{40 + idx}m"
+        setattr(ANSI, name, staticmethod(lambda s=fg: s))
+        setattr(ANSI, name + "_bg", staticmethod(lambda s=bg: s))
+        if idx is not None:
+            bright_fg = f"{_ESC}{90 + idx}m"
+            bright_bg = f"{_ESC}{100 + idx}m"
+            setattr(ANSI, "bright_" + name,
+                    staticmethod(lambda s=bright_fg: s))
+            setattr(ANSI, "bright_" + name + "_bg",
+                    staticmethod(lambda s=bright_bg: s))
+
+
+_add_colors()

@@ -1,0 +1,419 @@
+"""Stdlib package tests — ≙ the per-package _test.pony files aggregated
+by packages/stdlib/_test.pony (the reference's de-facto runtime
+integration suite, SURVEY.md §4)."""
+
+import pytest
+
+from ponyc_tpu.stdlib import persistent
+from ponyc_tpu.stdlib.buffered import IncompleteError, Reader, Writer
+from ponyc_tpu.stdlib.cli import (ArgSpec, CliSyntaxError, Command,
+                                  CommandHelp, CommandParser, CommandSpec,
+                                  EnvVars, OptionSpec)
+from ponyc_tpu.stdlib.collections import (BinaryHeap, Flags, List, MaxHeap,
+                                          MinHeap, Range, Reverse,
+                                          RingBuffer, Sort)
+from ponyc_tpu.stdlib.encode import Base64
+from ponyc_tpu.stdlib.format import (AlignCenter, AlignRight, Format,
+                                     FormatBinary, FormatFix, FormatHex,
+                                     FormatHexSmall, PrefixSign)
+from ponyc_tpu.stdlib.ini import IniMap
+from ponyc_tpu.stdlib.itertools import Iter
+from ponyc_tpu.stdlib.json import (JsonArray, JsonDoc, JsonObject,
+                                   JsonParseError)
+from ponyc_tpu.stdlib.math import Fibonacci
+from ponyc_tpu.stdlib.strings import CommonPrefix
+
+
+# ---- collections (≙ packages/collections/_test.pony) ----
+
+def test_flags():
+    A, B, C = 1, 2, 4
+    f = Flags().set(A).set(B)
+    assert f(A) and f(B) and not f(C)
+    f.unset(A)
+    assert not f(A)
+    g = Flags().set(A).set(C)
+    assert (f | g).value() == (B | A | C)
+    assert (f & g).value() == 0
+    assert Flags(A) <= Flags(A | B)
+    assert Flags(A) < Flags(A | B)
+    assert not (Flags(A | B) < Flags(A | B))
+
+
+def test_range():
+    assert list(Range(0, 5)) == [0, 1, 2, 3, 4]
+    assert list(Range(10, -5, -5)) == [10, 5, 0]
+    assert Range(0, 1, 0).is_infinite()
+    assert Range(0, 10, -1).is_infinite()
+    assert Range(0, 10, float("nan")).is_infinite()
+    assert list(Range(3, 3)) == []
+    r = Range(0, 3)
+    assert [r.next() for _ in range(2)] == [0, 1]
+    r.rewind()
+    assert r.next() == 0
+
+
+def test_heaps():
+    mn, mx = MinHeap(), MaxHeap()
+    for v in [5, 1, 4, 1, 9]:
+        mn.push(v)
+        mx.push(v)
+    assert [mn.pop() for _ in range(len(mn))] == [1, 1, 4, 5, 9]
+    assert [mx.pop() for _ in range(len(mx))] == [9, 5, 4, 1, 1]
+    with pytest.raises(IndexError):
+        BinaryHeap().pop()
+
+
+def test_ring_buffer():
+    rb = RingBuffer(4)
+    assert not any(rb.push(i) for i in range(4))
+    assert rb.push(4)            # overwrites 0
+    assert rb.head() == 1
+    assert rb(4) == 4 and rb(1) == 1
+    with pytest.raises(IndexError):
+        rb(0)                    # fell off
+    with pytest.raises(IndexError):
+        rb(5)                    # not yet written
+
+
+def test_sort_and_reverse():
+    a = [3, 1, 2, 9, 7, 7, 0]
+    assert Sort.apply(a) == sorted(a)
+    b = ["bb", "a", "ccc"]
+    assert Sort.by(b, len) == ["a", "bb", "ccc"]
+    assert list(Reverse(10, 2, 2)) == [10, 8, 6, 4, 2]
+
+
+def test_linked_list():
+    lst = List([1, 2, 3])
+    assert list(lst) == [1, 2, 3] and len(lst) == 3
+    node = lst.head().next()
+    node.remove()
+    assert list(lst) == [1, 3]
+    lst.unshift(0)
+    assert lst.shift() == 0
+    assert lst.pop() == 3
+    assert list(lst) == [1]
+
+
+# ---- persistent (≙ packages/collections/persistent/_test.pony) ----
+
+def test_persistent_map_basic():
+    m0 = persistent.Map()
+    m1 = m0.update("a", 1)
+    m2 = m1.update("b", 2)
+    m3 = m2.update("a", 10)
+    assert m0.size() == 0 and m1.size() == 1 and m2.size() == 2
+    assert m3.size() == 2
+    assert m1("a") == 1 and m3("a") == 10 and m2("a") == 1  # old intact
+    with pytest.raises(KeyError):
+        m0("a")
+    m4 = m3.remove("a")
+    assert not m4.contains("a") and m3.contains("a")
+    with pytest.raises(KeyError):
+        m4.remove("nope")
+    assert m2.get_or_else("zz", 42) == 42
+
+
+def test_persistent_map_stress():
+    n = 2000
+    m = persistent.Map()
+    for i in range(n):
+        m = m.update(f"k{i}", i)
+    assert m.size() == n
+    assert all(m(f"k{i}") == i for i in range(0, n, 97))
+    assert sorted(m.values()) == list(range(n))
+    for i in range(0, n, 2):
+        m = m.remove(f"k{i}")
+    assert m.size() == n // 2
+    assert m("k1") == 1 and not m.contains("k0")
+
+
+def test_persistent_vec():
+    v = persistent.Vec()
+    n = 1100                       # crosses the 32-wide tail + root split
+    for i in range(n):
+        v = v.push(i)
+    assert v.size() == n and v(0) == 0 and v(n - 1) == n - 1
+    v2 = v.update(500, -1)
+    assert v2(500) == -1 and v(500) == 500
+    for want in reversed(range(n)):
+        v, got = v.pop()
+        assert got == want
+    assert v.size() == 0
+    with pytest.raises(IndexError):
+        v.pop()
+    assert list(persistent.Vec.of("abc")) == ["a", "b", "c"]
+
+
+def test_persistent_list_and_set():
+    lst = persistent.List.of([1, 2, 3])
+    assert list(lst) == [1, 2, 3]
+    assert list(lst.prepend(0)) == [0, 1, 2, 3]
+    assert list(lst) == [1, 2, 3]                 # old unchanged
+    assert lst.map(lambda x: x * 2).fold(lambda a, b: a + b, 0) == 12
+    s = persistent.Set.of([1, 2, 3])
+    assert 2 in s and 9 not in s
+    assert sorted(s.union(persistent.Set.of([3, 4]))) == [1, 2, 3, 4]
+    assert sorted(s.intersect(persistent.Set.of([2, 3, 9]))) == [2, 3]
+    assert sorted(s.difference(persistent.Set.of([1]))) == [2, 3]
+
+
+# ---- json (≙ packages/json/_test.pony) ----
+
+def test_json_parse_basic():
+    d = JsonDoc()
+    d.parse('{"a": 1, "b": [true, null, 2.5, "x\\n"], "c": {"d": -3e2}}')
+    obj = d.data
+    assert isinstance(obj, JsonObject)
+    assert obj.data["a"] == 1 and isinstance(obj.data["a"], int)
+    arr = obj.data["b"]
+    assert isinstance(arr, JsonArray)
+    assert arr.data == [True, None, 2.5, "x\n"]
+    assert obj.data["c"].data["d"] == -300.0
+
+
+def test_json_roundtrip_and_pretty():
+    src = '{"k": [1, 2], "s": "hi"}'
+    d = JsonDoc()
+    d.parse(src)
+    assert d.string() == src
+    pretty = d.string(indent="  ", pretty_print=True)
+    assert pretty == '{\n  "k": [\n    1,\n    2\n  ],\n  "s": "hi"\n}'
+    d2 = JsonDoc()
+    d2.parse(pretty)
+    assert d2.data == d.data
+
+
+def test_json_unicode_escapes():
+    d = JsonDoc()
+    d.parse('"\\u0041\\ud83d\\ude00"')
+    assert d.data == "A\U0001F600"
+    with pytest.raises(JsonParseError):
+        d.parse('"\\ud83d"')     # lone high surrogate
+
+
+def test_json_errors_report_line():
+    d = JsonDoc()
+    with pytest.raises(JsonParseError):
+        d.parse('{"a": 1,\n "b": }')
+    line, msg = d.parse_report()
+    assert line == 2 and msg
+    for bad in ("{", "[1,]", "tru", '{"a" 1}', "01x", '"\\q"', "1 2"):
+        with pytest.raises(JsonParseError):
+            d.parse(bad)
+
+
+# ---- cli (≙ packages/cli/_test.pony) ----
+
+def _spec():
+    spec = CommandSpec.parent("tool", "A tool", options=[
+        OptionSpec.bool("verbose", "Noisy", short="v", default=False),
+        OptionSpec.string("name", "Name", short="n", default="anon"),
+    ])
+    spec.add_command(CommandSpec.leaf("run", "Run", options=[
+        OptionSpec.i64("count", "How many", short="c", default=1),
+        OptionSpec.string_seq("tag", "Tags", short="t"),
+    ], args=[ArgSpec.string("target", "Target"),
+             ArgSpec.f64("scale", "Scale", default=1.0)]))
+    spec.add_help()
+    return spec
+
+
+def test_cli_leaf_parse():
+    cmd = CommandParser(_spec()).parse(
+        ["tool", "-v", "run", "--count=3", "-t", "a", "-t", "b", "x",
+         "2.5"])
+    assert isinstance(cmd, Command)
+    assert cmd.full_name() == "tool/run"
+    assert cmd.option("verbose") is True
+    assert cmd.option("name") == "anon"
+    assert cmd.option("count") == 3
+    assert cmd.option("tag") == ("a", "b")
+    assert cmd.arg("target") == "x" and cmd.arg("scale") == 2.5
+
+
+def test_cli_short_combining_and_value():
+    spec = CommandSpec.leaf("t", options=[
+        OptionSpec.bool("a", short="a", default=False),
+        OptionSpec.bool("b", short="b", default=False),
+        OptionSpec.i64("n", short="n", default=0)])
+    cmd = CommandParser(spec).parse(["t", "-abn5"])
+    assert cmd.option("a") and cmd.option("b") and cmd.option("n") == 5
+
+
+def test_cli_errors():
+    p = CommandParser(_spec())
+    assert isinstance(p.parse(["tool", "nope"]), CliSyntaxError)
+    assert isinstance(p.parse(["tool", "--bogus", "run", "x"]),
+                      CliSyntaxError)
+    assert isinstance(p.parse(["tool", "run"]), CliSyntaxError)  # no target
+    assert isinstance(p.parse(["tool", "run", "--count=zz", "x"]),
+                      CliSyntaxError)
+    e = p.parse(["tool", "run", "x", "1.0", "extra"])
+    assert isinstance(e, CliSyntaxError) and "extra" in e.string()
+
+
+def test_cli_help_and_env():
+    p = CommandParser(_spec())
+    h = p.parse(["tool"])
+    assert isinstance(h, CommandHelp) and "Commands:" in h.help_string()
+    h2 = p.parse(["tool", "help", "run"])
+    assert isinstance(h2, CommandHelp) and "--count" in h2.help_string()
+    h3 = p.parse(["tool", "run", "x", "--help"])
+    assert isinstance(h3, CommandHelp)
+    env = EnvVars({"TOOL_NAME": "from-env"})
+    cmd = CommandParser(_spec(), env).parse(["tool", "run", "x"])
+    assert cmd.option("name") == "from-env"
+    # double dash ends option parsing
+    cmd2 = CommandParser(_spec()).parse(["tool", "run", "--", "-v"])
+    assert isinstance(cmd2, Command) and cmd2.arg("target") == "-v"
+
+
+# ---- buffered (≙ packages/buffered/_test.pony) ----
+
+def test_buffered_reader():
+    r = Reader()
+    w = Writer()
+    w.u8(7).u16_be(0x0102).u32_le(0x01020304).f32_be(1.5)
+    w.write(b"hello\r\nrest")
+    data = b"".join(w.done())
+    # Feed in awkward chunk boundaries.
+    r.append(data[:3])
+    r.append(data[3:8])
+    r.append(data[8:])
+    assert r.u8() == 7
+    assert r.u16_be() == 0x0102
+    assert r.u32_le() == 0x01020304
+    assert r.f32_be() == 1.5
+    assert r.line() == "hello"
+    assert r.block(4) == b"rest"
+    with pytest.raises(IncompleteError):
+        r.u8()
+
+
+def test_buffered_reader_peek_and_until():
+    r = Reader()
+    r.append(b"ab:cd")
+    assert r.peek_u8(0) == ord("a") and r.peek_u8(3) == ord("c")
+    assert r.read_until(ord(":")) == b"ab"
+    assert r.block(2) == b"cd"
+    assert r.size() == 0
+    r.append(b"no-newline")
+    with pytest.raises(IncompleteError):
+        r.line()
+    assert r.size() == 10        # failed read consumed nothing
+
+
+def test_buffered_signed_and_64():
+    w = Writer()
+    w.i32_be(-2).u64_le(2**63 + 5).i64_be(-(2**40)).f64_be(0.25)
+    r = Reader()
+    r.append(b"".join(w.done()))
+    assert r.i32_be() == -2
+    assert r.u64_le() == 2**63 + 5
+    assert r.i64_be() == -(2**40)
+    assert r.f64_be() == 0.25
+
+
+# ---- base64 (≙ packages/encode/base64/_test.pony) ----
+
+def test_base64_rfc_vectors():
+    vec = {"": "", "f": "Zg==", "fo": "Zm8=", "foo": "Zm9v",
+           "foob": "Zm9vYg==", "fooba": "Zm9vYmE=", "foobar": "Zm9vYmFy"}
+    for plain, enc in vec.items():
+        assert Base64.encode(plain) == enc
+        assert Base64.decode(enc) == plain.encode()
+
+
+def test_base64_url_and_lines():
+    data = bytes(range(256))
+    assert Base64.decode_url(Base64.encode_url(data)) == data
+    assert "+" not in Base64.encode_url(data)
+    pem = Base64.encode_pem(b"x" * 100)
+    first = pem.split("\r\n")[0]
+    assert len(first) == 64
+    assert Base64.decode(pem) == b"x" * 100
+    with pytest.raises(ValueError):
+        Base64.decode("a!b")
+
+
+# ---- format (≙ packages/format/_test.pony) ----
+
+def test_format_int():
+    assert Format.int(255, FormatHex) == "0xFF"
+    assert Format.int(255, FormatHexSmall) == "0xff"
+    assert Format.int(5, FormatBinary) == "0b101"
+    assert Format.int(42, width=6) == "    42"
+    assert Format.int(42, width=6, fill="0") == "000042"
+    assert Format.int(42, prefix=PrefixSign) == "+42"
+    assert Format.int(-42, FormatHex) == "-0x2A"
+    assert Format.int(7, precision=3) == "007"
+
+
+def test_format_float_and_string():
+    assert Format.float(1234.5678, FormatFix, precision=2) == "1234.57"
+    assert Format.float(1234.5678, "exp", precision=1) == "1.2e+03"
+    assert Format("hi", width=6, align=AlignCenter, fill=".") == "..hi.."
+    assert Format("truncated", precision=4) == "trun"
+    assert Format(true_val := True) == "true" and true_val
+
+
+# ---- itertools (≙ packages/itertools/_test.pony) ----
+
+def test_iter_combinators():
+    assert Iter(range(10)).filter(lambda x: x % 2 == 0).map(
+        lambda x: x * x).collect() == [0, 4, 16, 36, 64]
+    assert Iter("abc").enum().collect() == [(0, "a"), (1, "b"), (2, "c")]
+    assert Iter([1, 1, 2, 1]).unique().collect() == [1, 2, 1]
+    assert Iter([1, 1, 2, 1]).dedup().collect() == [1, 2]
+    assert Iter(range(100)).skip(95).take(3).collect() == [95, 96, 97]
+    assert Iter([1, 2, 3]).fold(0, lambda a, b: a + b) == 6
+    assert Iter([[1], [2, 3]]).flat_map(lambda x: x).collect() == [1, 2, 3]
+    assert Iter.chain([[1], [], [2]]).collect() == [1, 2]
+    assert Iter([1, 2]).zip("ab").collect() == [(1, "a"), (2, "b")]
+    assert Iter(range(5)).step_by(2).collect() == [0, 2, 4]
+    assert Iter([1, 2]).interleave([10, 20, 30]).collect() == \
+        [1, 10, 2, 20, 30]
+    assert Iter(range(5)).nth(2) == 1
+    assert Iter(Iter.repeat_value(7).take(3)).collect() == [7, 7, 7]
+    assert Iter([1, 2, 3]).last() == 3
+    assert Iter([]).count() == 0
+    it = Iter([1])
+    assert it.has_next() and it.next() == 1 and not it.has_next()
+    with pytest.raises(IndexError):
+        Iter([1]).find(lambda x: x > 5)
+
+
+# ---- ini (≙ packages/ini/_test.pony) ----
+
+def test_ini_map():
+    src = """
+; comment
+top = 1
+[sec]
+a = hello ; trailing comment
+b: colon-delimited
+# another comment
+[empty]
+""".splitlines()
+    m = IniMap.apply(src)
+    assert m[""]["top"] == "1"
+    assert m["sec"]["a"] == "hello"
+    assert m["sec"]["b"] == "colon-delimited"
+    assert m["empty"] == {}
+    with pytest.raises(ValueError):
+        IniMap.apply(["[unclosed"])
+    with pytest.raises(ValueError):
+        IniMap.apply(["keywithoutvalue"])
+
+
+# ---- strings / math ----
+
+def test_common_prefix_and_fibonacci():
+    assert CommonPrefix(["doable", "doing", "dock"]) == "do"
+    assert CommonPrefix(["a", "b"]) == ""
+    assert CommonPrefix([]) == ""
+    assert CommonPrefix([123, 124]) == "12"
+    assert Iter(Fibonacci()).take(8).collect() == [0, 1, 1, 2, 3, 5, 8, 13]
+    assert Fibonacci.apply(10) == 55
