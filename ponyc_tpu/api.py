@@ -193,8 +193,11 @@ class Context:
             raise RuntimeError(
                 f"{behaviour_def} not registered in a Program yet")
         payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
-        words = jnp.concatenate(
-            [jnp.asarray([behaviour_def.global_id], jnp.int32), payload])
+        # Planar-aware: payload is [W] (all-constant args) or [W, R]
+        # (lane vectors); the gid row matches its trailing shape.
+        gid_row = jnp.full((1,) + payload.shape[1:],
+                           behaviour_def.global_id, jnp.int32)
+        words = jnp.concatenate([gid_row, payload], axis=0)
         self.sends.append((jnp.asarray(target, jnp.int32), words,
                            jnp.asarray(when, jnp.bool_)))
 

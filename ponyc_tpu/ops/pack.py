@@ -68,7 +68,11 @@ def unpack_arg(ann, word):
 
 
 def pack_args(specs, values, msg_words):
-    """Pack positional args into a [msg_words] int32 vector (zero padded)."""
+    """Pack positional args into a [msg_words] (or planar [msg_words, R])
+    int32 array, zero padded. Args may mix trace-time constants (scalars)
+    with [R]-lane vectors — the planar engine evaluates behaviours on all
+    R actors of a cohort at once — so words broadcast to a common shape
+    before stacking on the (small, major) word axis."""
     if len(values) != len(specs):
         raise TypeError(f"behaviour takes {len(specs)} args, got {len(values)}")
     if len(specs) > msg_words:
@@ -77,6 +81,8 @@ def pack_args(specs, values, msg_words):
             f"{msg_words}; raise RuntimeOptions.msg_words")
     words = [pack_arg(a, v) for a, v in zip(specs, values)]
     words += [jnp.int32(0)] * (msg_words - len(words))
+    if len(words) > 1:
+        words = jnp.broadcast_arrays(*words)
     return jnp.stack(words)
 
 

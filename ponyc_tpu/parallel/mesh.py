@@ -31,9 +31,12 @@ def make_mesh(n_shards: Optional[int] = None, devices=None) -> Mesh:
 
 
 def shard_state(state, mesh: Mesh):
-    """Place every runtime array with its leading axis over 'actors'."""
-    spec = NamedSharding(mesh, PartitionSpec("actors"))
-    return jax.tree.map(lambda x: jax.device_put(x, spec), state)
+    """Place every runtime array with its LAST axis over 'actors' (the
+    actor-lane axis — see runtime/state.py's layout note)."""
+    def put(x):
+        spec = PartitionSpec(*([None] * (x.ndim - 1) + ["actors"]))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree.map(put, state)
 
 
 def replicated(mesh: Mesh):

@@ -17,12 +17,16 @@ within one shard of the actor world:
   3. per-target segment bounds come from a vectorised binary search over
      the sorted keys; each target accepts min(count, free-space), so
      rejections are always the newest suffix per target, keeping FIFO safe;
-  4. the mailbox table is rebuilt with a *dense gather* over [rows, cap]:
-     ring slot (tail+j)%cap takes sorted entry seg_start+j. TPU-first
-     design note: XLA lowers large scatters to serial loops on TPU, so the
-     one scatter the CPU-obvious design would use here was the whole
-     step's bottleneck — the gather form is fully vectorised (the extra
-     rows×cap reads are cheap next to a serialised 1M-element scatter);
+  4. the mailbox table is rebuilt slot-plane by slot-plane: ring slot c of
+     every actor at once takes sorted entry seg_start + (c - tail) % cap.
+     TPU-first design notes: (a) XLA lowers large scatters to serial
+     loops on TPU, so the one scatter the CPU-obvious design would use
+     was the whole step's bottleneck — the gather form is fully
+     vectorised; (b) the mailbox table is laid out [cap, words, N] with
+     the actor axis minor-most, so each plane op is a full-width
+     128-lane vector op and the per-plane pull from the sorted entries
+     is a plain 1-D lane gather (see state.py's layout note — the
+     actor-major form ran ~30× slower on real TPU from tile padding);
   5. rejections compact into the next spill buffer and their locally
      resident senders mute (≙ ponyint_maybe_mute: mute on sending to an
      overloaded/muted receiver, actor.c:898-921). Both are *pressure
@@ -47,7 +51,7 @@ class Entries(NamedTuple):
     the routing layer in engine.py deals in global ids)."""
     tgt: jnp.ndarray      # [E] int32 target row; -1 = empty slot
     sender: jnp.ndarray   # [E] int32 sender *global* id; -1 = host/no sender
-    words: jnp.ndarray    # [E, 1+W] int32 (word0 = behaviour gid)
+    words: jnp.ndarray    # [1+W, E] int32 (word0 = behaviour gid)
 
 
 class DeliveryResult(NamedTuple):
@@ -57,7 +61,7 @@ class DeliveryResult(NamedTuple):
     spill_count: jnp.ndarray   # [] int32
     spill_overflow: jnp.ndarray
     newly_muted: jnp.ndarray   # [n_local] bool (local senders only)
-    new_mute_refs: jnp.ndarray  # [n_local, K] global refs slotted by
+    new_mute_refs: jnp.ndarray  # [K, n_local] global refs slotted by
     #                               ref % K (-1 = empty)
     new_mute_ovf: jnp.ndarray  # [n_local] bool — distinct refs collided
     #                               in one slot this tick
@@ -71,22 +75,22 @@ class DeliveryResult(NamedTuple):
 
 def mute_ref_slots(trig, mute_row, refs, *, n: int, k: int):
     """Scatter triggered (sender-row, receiver-ref) mute pairs into the
-    per-sender K-slot ref table (slot = ref % K). Returns (refs [n, K],
-    ovf [n]) where ovf marks rows where two *distinct* refs collided in
-    one slot this tick (≙ a mutemap set outgrowing its fixed width)."""
+    K-slot-per-sender ref table (slot = ref % K). Returns (refs [k, n],
+    ovf [n]) where ovf marks senders where two *distinct* refs collided
+    in one slot this tick (≙ a mutemap set outgrowing its fixed width)."""
     big = jnp.int32(2**31 - 1)
     slot = jnp.where(trig, refs % k, 0)
     row = jnp.where(trig, mute_row, n)
-    rmax = jnp.full((n, k), -1, jnp.int32).at[row, slot].max(
+    rmax = jnp.full((k, n), -1, jnp.int32).at[slot, row].max(
         jnp.where(trig, refs, -1), mode="drop")
-    rmin = jnp.full((n, k), big, jnp.int32).at[row, slot].min(
+    rmin = jnp.full((k, n), big, jnp.int32).at[slot, row].min(
         jnp.where(trig, refs, big), mode="drop")
-    ovf = jnp.any((rmax >= 0) & (rmin != rmax), axis=1)
+    ovf = jnp.any((rmax >= 0) & (rmin != rmax), axis=0)
     return rmax, ovf
 
 
 def empty_mute_slots(n: int, k: int):
-    return jnp.full((n, k), -1, jnp.int32), jnp.zeros((n,), jnp.bool_)
+    return jnp.full((k, n), -1, jnp.int32), jnp.zeros((n,), jnp.bool_)
 
 
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
@@ -149,13 +153,13 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             lambda _: _compute_plan(key),
             operand=None)
 
-    w1 = words.shape[1]
+    w1 = words.shape[0]
 
     def _empty_spill():
         refs, ovf = empty_mute_slots(n, mute_slots)
         return (Entries(tgt=jnp.full((spill_cap,), -1, jnp.int32),
                         sender=jnp.full((spill_cap,), -1, jnp.int32),
-                        words=jnp.zeros((spill_cap, w1), jnp.int32)),
+                        words=jnp.zeros((w1, spill_cap), jnp.int32)),
                 jnp.zeros((n,), jnp.bool_), refs, ovf)
 
     # Everything below only matters when at least one message exists this
@@ -164,7 +168,7 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     # it exists, README.md:8-10 — a waiting scheduler must cost ~nothing).
     def with_msgs(_):
         kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
-        wds = words[perm]
+        wds = words[:, perm]                     # [w1, E] sorted
         ktc = jnp.minimum(kt, n - 1)
         seg_start = bounds[:-1]                  # [n]
         cnt = bounds[1:] - seg_start             # [n] msgs per target
@@ -173,12 +177,18 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
         acc = jnp.minimum(cnt, space)            # accepted per target
         new_tail = tail + acc
 
-        # Dense ring rebuild: slot (tail+j)%cap ← sorted entry seg_start+j.
-        slots = jnp.arange(c, dtype=jnp.int32)[None, :]
-        rel = (slots - tail[:, None]) % c        # j for each ring slot
-        wmask = rel < acc[:, None]               # this slot gets a message
-        src = jnp.minimum(seg_start[:, None] + rel, e - 1)
-        buf2 = jnp.where(wmask[:, :, None], wds[src], buf)
+        # Slot-plane ring rebuild: plane c (ring slot c of every actor)
+        # pulls sorted entry seg_start + (c - tail) % cap — one 1-D lane
+        # gather + select per plane, `cap` static planes.
+        planes = []
+        for ci in range(c):
+            rel = (ci - tail) % c                # [n] rank for this slot
+            wmask = rel < acc                    # this slot gets a message
+            src = jnp.minimum(seg_start + rel, e - 1)
+            planes.append(jnp.where(wmask[None, :],
+                                    jnp.take(wds, src, axis=1),
+                                    buf[ci]))
+        buf2 = jnp.stack(planes)
 
         n_delivered = jnp.sum(acc)
         nrej = jnp.sum(cnt - acc)
@@ -195,7 +205,7 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             spill = Entries(
                 tgt=jnp.where(vspill, kt[perm2], -1),
                 sender=jnp.where(vspill, snd[perm2], -1),
-                words=jnp.where(vspill[:, None], wds[perm2], 0),
+                words=jnp.where(vspill[None, :], wds[:, perm2], 0),
             )
             # Mute triggers (≙ actor.c:898-921 + mute rules
             # actor.c:1171-1235): a valid send whose receiver rejected it

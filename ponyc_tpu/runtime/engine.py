@@ -78,20 +78,44 @@ class StepAux(NamedTuple):
     n_mutes: jnp.ndarray         # int32
 
 
+def _ring_take(buf_rows, slot):
+    """Pull ring-slot `slot[r]` of every actor r: [cap, w1, R] × [R] →
+    [w1, R]. The per-lane index varies only over the small static `cap`
+    axis, so a static select chain keeps every op a full-width vector op
+    (a gather along a tiny major axis would defeat the lane layout —
+    see state.py's layout note)."""
+    cap = buf_rows.shape[0]
+    out = buf_rows[0]
+    for c in range(1, cap):
+        out = jnp.where((slot == c)[None, :], buf_rows[c], out)
+    return out
+
+
+def _bcast_lanes(v, dtype, lanes: int):
+    """Canonicalise a behaviour output to a [lanes] vector (user code may
+    return trace-time constants — Python scalars — for some lanes-wide
+    quantities)."""
+    return jnp.broadcast_to(jnp.asarray(v, dtype), (lanes,))
+
+
 def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
-                 spawn_sites, effects):
-    """Wrap one behaviour into a switch branch with canonical outputs.
+                 spawn_sites, effects, lanes: int):
+    """Wrap one behaviour as a *planar* evaluator: it runs on ALL `lanes`
+    actors of the cohort at once (state fields, args, and effect masks
+    are [lanes] vectors) and the dispatcher selects its outputs where the
+    message's behaviour id matches. This is exactly what `vmap` over
+    `lax.switch` executes (batched switch runs every branch and selects),
+    but written planar so no actor-major [lanes, small] intermediate is
+    ever materialised (see state.py's layout note).
 
     spawn_sites: ordered (target_name, n_sites) static budget — every
-    branch of a cohort's switch emits claims in this exact layout.
-    effects: trace-time mutable record of which effects any behaviour of
-    the cohort actually used (lets the engine skip dead scatters)."""
+    branch emits claims in this exact layout. effects: trace-time mutable
+    record of which effects any behaviour of the cohort used (lets the
+    engine skip dead scatters)."""
     w1 = 1 + msg_words
 
-    def branch(operand):
-        st, payload, actor_id, resv = operand
-        resv_dict = {t: r for (t, _), r in zip(spawn_sites, resv)}
-        ctx = Context(actor_id, msg_words, spawn_resv=resv_dict)
+    def branch(st, payload, ids_vec, resv_k):
+        ctx = Context(ids_vec, msg_words, spawn_resv=resv_k)
         args = pack.unpack_args(bdef.arg_specs, payload)
         st2 = bdef.fn(ctx, dict(st), *args)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
@@ -104,7 +128,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
             raise TypeError(
                 f"behaviour {bdef} changed the state fields: "
                 f"{sorted(st2)} vs {sorted(st)}")
-        st2 = {k: jnp.asarray(v, field_dtypes[k]) for k, v in st2.items()}
+        st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
+               for k, v in st2.items()}
         if len(ctx.sends) > max_sends:
             raise RuntimeError(
                 f"behaviour {bdef} performs {len(ctx.sends)} sends but the "
@@ -112,55 +137,49 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                 f"{len(ctx.sends)} on the actor class")
         tgts, words = [], []
         for (t, w, when) in ctx.sends:
+            t = _bcast_lanes(t, jnp.int32, lanes)
+            when = _bcast_lanes(when, jnp.bool_, lanes)
+            w = jnp.broadcast_to(w.reshape(w1, -1), (w1, lanes))
             tgts.append(jnp.where(when, t, jnp.int32(-1)))
             words.append(w)
         for _ in range(max_sends - len(ctx.sends)):
-            tgts.append(jnp.int32(-1))
-            words.append(jnp.zeros((w1,), jnp.int32))
-        tgt_arr = jnp.stack(tgts) if tgts else jnp.zeros((0,), jnp.int32)
-        words_arr = (jnp.stack(words) if words
-                     else jnp.zeros((0, w1), jnp.int32))
+            tgts.append(jnp.full((lanes,), -1, jnp.int32))
+            words.append(jnp.zeros((w1, lanes), jnp.int32))
         claims = []
         for tname, n in spawn_sites:
-            got = ctx.spawn_claims.get(tname, [])
-            got = got + [jnp.int32(-1)] * (n - len(got))
-            claims.append(jnp.stack(got) if got
-                          else jnp.zeros((0,), jnp.int32))
-        return (st2, (tgt_arr, words_arr),
-                (ctx.exit_flag, ctx.exit_code), ctx.yield_flag,
-                tuple(claims), ctx.spawn_fail, ctx.destroy_flag,
-                (ctx.error_flag, ctx.error_code))
-
-    return branch
-
-
-def _make_noop_branch(msg_words: int, max_sends: int, spawn_sites):
-    w1 = 1 + msg_words
-
-    def branch(operand):
-        st, _payload, _actor_id, _resv = operand
-        return (dict(st),
-                (jnp.full((max_sends,), -1, jnp.int32),
-                 jnp.zeros((max_sends, w1), jnp.int32)),
-                (jnp.bool_(False), jnp.int32(0)),
-                jnp.bool_(False),
-                tuple(jnp.full((n,), -1, jnp.int32)
-                      for _, n in spawn_sites),
-                jnp.bool_(False), jnp.bool_(False),
-                (jnp.bool_(False), jnp.int32(0)))
+            got = [_bcast_lanes(g, jnp.int32, lanes)
+                   for g in ctx.spawn_claims.get(tname, [])]
+            got += [jnp.full((lanes,), -1, jnp.int32)] * (n - len(got))
+            claims.append(got)
+        b = jnp.bool_
+        return (st2, (tgts, words),
+                (_bcast_lanes(ctx.exit_flag, b, lanes),
+                 _bcast_lanes(ctx.exit_code, jnp.int32, lanes)),
+                _bcast_lanes(ctx.yield_flag, b, lanes),
+                claims,
+                _bcast_lanes(ctx.spawn_fail, b, lanes),
+                _bcast_lanes(ctx.destroy_flag, b, lanes),
+                (_bcast_lanes(ctx.error_flag, b, lanes),
+                 _bcast_lanes(ctx.error_code, jnp.int32, lanes)))
 
     return branch
 
 
 def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
-    """Build the vmapped per-actor drain loop for one cohort.
+    """Build the planar per-cohort drain loop.
 
     ≙ ponyint_actor_run (actor.c:383-549): pop ≤batch app messages,
-    dispatch each, honour yield (fork: actor.c:675-679), count consumption.
+    dispatch each, honour yield (fork: actor.c:675-679), count
+    consumption — for every actor of the cohort at once, as [rows]-wide
+    vector ops (actors on the 128 TPU lanes, batch slots iterated by a
+    lax.scan whose carries are all lane-shaped).
     """
     msg_words = opts.msg_words
     ms = cohort.max_sends
     batch = cohort.batch
+    cap = opts.mailbox_cap
+    rows = cohort.local_capacity
+    w1 = 1 + msg_words
     field_dtypes = {}
     for fname, spec in cohort.atype.field_specs.items():
         field_dtypes[fname] = (jnp.float32 if spec is pack.F32
@@ -168,87 +187,117 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
     spawn_sites = tuple(sorted(cohort.spawns.items()))
     effects = {"destroy": False, "error": False}
     branches = [_make_branch(b, msg_words, ms, field_dtypes, spawn_sites,
-                             effects)
+                             effects, rows)
                 for b in cohort.behaviours]
-    branches.append(_make_noop_branch(msg_words, ms, spawn_sites))
     nb = len(cohort.behaviours)
     base = cohort.behaviours[0].global_id if nb else 0
-
     sd = cohort.spawn_dispatches
-
-    def actor_fn(st_row, msgs, valids, actor_id, resv):
-        # msgs: [batch, 1+W]; valids: [batch] bool;
-        # resv: {target: [spawn_dispatches, sites]} reserved refs — a
-        # `used` counter hands one dispatch-worth of reservations to each
-        # spawning message; exceeding the SPAWN_DISPATCHES budget yields
-        # -1 refs (→ the sticky spawn_fail, never a double claim).
-        def scan_body(carry, x):
-            (st, stopped, ef, ec, sfail, dstr, errf, errc, used, nproc,
-             nbad) = carry
-            msg, valid = x
-            resv_k = tuple(
-                jnp.where(used < sd,
-                          resv[t][jnp.minimum(used, sd - 1)],
-                          jnp.int32(-1))
-                for t, _ in spawn_sites)
-            local = msg[0] - base
-            in_range = (local >= 0) & (local < nb)
-            do = valid & ~stopped
-            bid = jnp.where(do & in_range, local, nb)
-            (st2, (stgt, swords), (bef, bec), yf, claims, bsf, bdstr,
-             (bErrF, bErrC)) = lax.switch(bid, branches,
-                                          (st, msg[1:], actor_id, resv_k))
-            spawned_here = bsf
-            for cl in claims:
-                if cl.shape[0]:
-                    spawned_here = spawned_here | jnp.any(cl >= 0)
-            new_ef = ef | bef
-            new_ec = jnp.where(bef & ~ef, bec, ec)
-            stopped2 = stopped if noyield else (stopped | yf)
-            return ((st2, stopped2, new_ef, new_ec, sfail | bsf,
-                     dstr | bdstr, errf | bErrF,
-                     jnp.where(bErrF, bErrC, errc),
-                     used + spawned_here.astype(jnp.int32),
-                     nproc + (do & in_range).astype(jnp.int32),
-                     nbad + (do & ~in_range).astype(jnp.int32)),
-                    (stgt, swords, do, claims))
-
-        carry0 = (st_row, jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
-                  jnp.bool_(False), jnp.bool_(False), jnp.bool_(False),
-                  jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0))
-        ((stf, _, ef, ec, sfail, dstr, errf, errc, _used, nproc, nbad),
-         (stgt, swords, consumed, claims)) = lax.scan(
-            scan_body, carry0, (msgs, valids))
-        n_consumed = jnp.sum(consumed.astype(jnp.int32))
-        return (stf, (stgt, swords), ef, ec, sfail, dstr, (errf, errc),
-                nproc, nbad, n_consumed, claims)
-
-    vfn = jax.vmap(actor_fn)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
                    runnable_rows, ids, resv):
-        e = cohort.local_capacity * batch * ms
-        sender = jnp.repeat(ids, batch * ms)
-        rows = cohort.local_capacity
-        w1 = 1 + msg_words
+        # buf_rows: [cap, w1, rows]; resv: {target: [sd, sites, rows]}.
+        e = rows * batch * ms
+
+        def scan_body(carry, x):
+            (st, stopped, ef, ec, sfail, dstr, errf, errc, used,
+             nproc, nbad) = carry
+            msg, valid = x                    # msg [w1, rows], valid [rows]
+            # Hand one dispatch-worth of spawn reservations to this batch
+            # slot: a `used` counter walks the SPAWN_DISPATCHES axis;
+            # exhausted budget yields -1 refs (→ sticky spawn_fail,
+            # never a double claim).
+            resv_k = {}
+            for t, n_sites in spawn_sites:
+                rt_ = resv[t]                 # [sd, sites, rows]
+                sel = jnp.full((n_sites, rows), -1, jnp.int32)
+                for d in range(sd):
+                    sel = jnp.where((used == d)[None, :], rt_[d], sel)
+                resv_k[t] = sel
+            local = msg[0] - base
+            in_range = (local >= 0) & (local < nb)
+            do = valid & ~stopped
+            # Planar dispatch: evaluate every behaviour on all lanes and
+            # select per lane by behaviour id (what a vmapped lax.switch
+            # executes, without the actor-major materialisations).
+            st_n = dict(st)
+            tgt_n = [jnp.full((rows,), -1, jnp.int32) for _ in range(ms)]
+            wrd_n = [jnp.zeros((w1, rows), jnp.int32) for _ in range(ms)]
+            ef_n = jnp.zeros((rows,), jnp.bool_)
+            ec_n = jnp.zeros((rows,), jnp.int32)
+            yf_n = jnp.zeros((rows,), jnp.bool_)
+            sf_n = jnp.zeros((rows,), jnp.bool_)
+            ds_n = jnp.zeros((rows,), jnp.bool_)
+            erf_n = jnp.zeros((rows,), jnp.bool_)
+            erc_n = jnp.zeros((rows,), jnp.int32)
+            clm_n = [[jnp.full((rows,), -1, jnp.int32)
+                      for _ in range(n)] for _, n in spawn_sites]
+            for j, br in enumerate(branches):
+                take = (do & in_range & (local == j))
+                (st2, (btgt, bwrd), (bef, bec), byf, bclm, bsf, bds,
+                 (berf, berc)) = br(st, msg[1:], ids, resv_k)
+                for k in st_n:
+                    st_n[k] = jnp.where(take, st2[k], st_n[k])
+                for m in range(ms):
+                    tgt_n[m] = jnp.where(take, btgt[m], tgt_n[m])
+                    wrd_n[m] = jnp.where(take[None, :], bwrd[m], wrd_n[m])
+                ef_n = jnp.where(take, bef, ef_n)
+                ec_n = jnp.where(take, bec, ec_n)
+                yf_n = jnp.where(take, byf, yf_n)
+                sf_n = jnp.where(take, bsf, sf_n)
+                ds_n = jnp.where(take, bds, ds_n)
+                erf_n = jnp.where(take, berf, erf_n)
+                erc_n = jnp.where(take, berc, erc_n)
+                for si, (_, n) in enumerate(spawn_sites):
+                    for s in range(n):
+                        clm_n[si][s] = jnp.where(take, bclm[si][s],
+                                                 clm_n[si][s])
+            spawned_here = sf_n
+            for si in range(len(spawn_sites)):
+                for s in range(len(clm_n[si])):
+                    spawned_here = spawned_here | (clm_n[si][s] >= 0)
+            new_ef = ef | ef_n
+            new_ec = jnp.where(ef_n & ~ef, ec_n, ec)
+            stopped2 = stopped if noyield else (stopped | yf_n)
+            stgt = jnp.stack(tgt_n) if ms else jnp.zeros((0, rows),
+                                                         jnp.int32)
+            swrd = jnp.stack(wrd_n) if ms else jnp.zeros((0, w1, rows),
+                                                         jnp.int32)
+            claims = tuple(
+                (jnp.stack(c) if c else jnp.zeros((0, rows), jnp.int32))
+                for c in clm_n)
+            return ((st_n, stopped2, new_ef, new_ec, sfail | sf_n,
+                     dstr | ds_n, errf | erf_n,
+                     jnp.where(erf_n, erc_n, errc),
+                     used + spawned_here.astype(jnp.int32),
+                     nproc + (do & in_range).astype(jnp.int32),
+                     nbad + (do & ~in_range).astype(jnp.int32)),
+                    (stgt, swrd, do, claims))
 
         def busy_fn(_):
             n_run = jnp.where(runnable_rows,
                               jnp.minimum(occ_rows, batch), 0)
-            k = jnp.arange(batch, dtype=jnp.int32)
-            idx = (head_rows[:, None] + k[None, :]) % opts.mailbox_cap
-            msgs = jnp.take_along_axis(buf_rows, idx[:, :, None], axis=1)
-            valids = k[None, :] < n_run[:, None]
-            (stf, (stgt, swords), ef, ec, sfail, dstr, errs, nproc, nbad,
-             n_consumed, claims) = vfn(type_state_rows, msgs, valids, ids,
-                                       resv)
+            msgs = jnp.stack([_ring_take(buf_rows, (head_rows + k) % cap)
+                              for k in range(batch)])   # [batch, w1, rows]
+            valids = (jnp.arange(batch, dtype=jnp.int32)[:, None]
+                      < n_run[None, :])                 # [batch, rows]
+            z = lambda d: jnp.zeros((rows,), d)         # noqa: E731
+            carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
+                      z(jnp.int32), z(jnp.bool_), z(jnp.bool_),
+                      z(jnp.bool_), z(jnp.int32), z(jnp.int32),
+                      z(jnp.int32), z(jnp.int32))
+            ((stf, _, ef, ec, sfail, dstr, errf, errc, _used, nproc,
+              nbad),
+             (stgt, swrd, consumed, claims)) = lax.scan(
+                scan_body, carry0, (msgs, valids))
+            # stgt [batch, ms, rows] → flat [e] with rows minor;
+            # swrd [batch, ms, w1, rows] → [w1, e] planar.
+            n_consumed = jnp.sum(consumed.astype(jnp.int32), axis=0)
+            out_tgt = stgt.reshape(e)
+            out_words = jnp.moveaxis(swrd, 2, 0).reshape(w1, e)
             any_exit = jnp.any(ef)
             code = ec[jnp.argmax(ef)]
-            errf, errc = errs
-            # claims: tuple aligned with spawn_sites, [rows, batch, sites].
-            return (stf, stgt.reshape(e), swords.reshape(e, w1),
-                    head_rows + n_consumed, any_exit, code,
-                    jnp.sum(nproc), jnp.sum(nbad),
+            return (stf, out_tgt, out_words, head_rows + n_consumed,
+                    any_exit, code, jnp.sum(nproc), jnp.sum(nbad),
                     tuple(c.reshape(-1) for c in claims),
                     jnp.any(sfail), dstr, errf, errc)
 
@@ -259,10 +308,10 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
             # entirely — one reduction decides.
             return (type_state_rows,
                     jnp.full((e,), -1, jnp.int32),
-                    jnp.zeros((e, w1), jnp.int32),
+                    jnp.zeros((w1, e), jnp.int32),
                     head_rows, jnp.bool_(False), jnp.int32(0),
                     jnp.int32(0), jnp.int32(0),
-                    tuple(jnp.full((rows * batch * n,), -1, jnp.int32)
+                    tuple(jnp.full((batch * n * rows,), -1, jnp.int32)
                           for _, n in spawn_sites),
                     jnp.bool_(False),
                     jnp.zeros((rows,), jnp.bool_),
@@ -275,6 +324,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         (stf, out_tgt, out_words, new_head, any_exit, code, nproc, nbad,
          claims_t, sfail, dstr, errf, errc) = lax.cond(
             busy, busy_fn, idle_fn, operand=None)
+        sender = jnp.tile(ids, batch * ms)    # entry (b, m, r): sender=ids[r]
         out = Entries(tgt=out_tgt, sender=sender, words=out_words)
         flat_claims = {t: c for (t, _), c in zip(spawn_sites, claims_t)}
         return (stf, out, new_head, any_exit, code, nproc, nbad,
@@ -306,7 +356,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     dt = dest[perm]
     ts = tgt[perm]
     ss = sender[perm]
-    ws = words[perm]
+    ws = words[:, perm]                              # [w1, E] planar
     # Per-destination segment bounds via binary search; the bucket table
     # is then a dense gather [shards, bucket] from the sorted entries —
     # same scatter-free design as delivery.py (TPU scatters serialise).
@@ -320,18 +370,18 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     src = jnp.minimum(seg_start[:, None] + j, e - 1)
     bt = jnp.where(fill, ts[src], -1).reshape(shards * bucket)
     bs = jnp.where(fill, ss[src], -1).reshape(shards * bucket)
-    bw = jnp.where(fill[:, :, None], ws[src], 0).reshape(
-        shards * bucket, -1)
+    fill_f = fill.reshape(shards * bucket)
+    bw = jnp.where(fill_f[None, :], ws[:, src.reshape(-1)], 0)
 
     rt = lax.all_to_all(bt, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
     rs = lax.all_to_all(bs, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
-    rw = lax.all_to_all(bw, "actors", split_axis=0, concat_axis=0,
+    rw = lax.all_to_all(bw, "actors", split_axis=1, concat_axis=1,
                         tiled=True)
 
     nrej = jnp.sum(cnt - acc)
-    w1 = words.shape[1]
+    w1 = words.shape[0]
 
     def pressure(_):
         # Bucket overflow → route spill (stays on this shard, ordered)
@@ -343,7 +393,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
         spill = Entries(
             tgt=jnp.where(vsp, ts[perm2], -1),
             sender=jnp.where(vsp, ss[perm2], -1),
-            words=jnp.where(vsp[:, None], ws[perm2], 0),
+            words=jnp.where(vsp[None, :], ws[:, perm2], 0),
         )
         lsnd = ss - shard_base
         s_ok = rej & (lsnd >= 0) & (lsnd < n_local)
@@ -361,7 +411,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
         refs, ovf = empty_mute_slots(n_local, mute_slots)
         return (Entries(tgt=jnp.full((rspill_cap,), -1, jnp.int32),
                         sender=jnp.full((rspill_cap,), -1, jnp.int32),
-                        words=jnp.zeros((rspill_cap, w1), jnp.int32)),
+                        words=jnp.zeros((w1, rspill_cap), jnp.int32)),
                 jnp.zeros((n_local,), jnp.bool_), refs, ovf)
 
     new_rspill, newly_muted, new_refs, new_ovf = lax.cond(
@@ -417,7 +467,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             # ≙ ponyint_sched_unmute_senders walking the mutemap
             # receiver-set (scheduler.c:1552-1635): a sender releases only
             # when EVERY tracked muting receiver has recovered.
-            refs = st.mute_refs                       # [nl, K]
+            refs = st.mute_refs                       # [K, nl]
             has = refs >= 0
             lref = refs - base
             ref_local = (lref >= 0) & (lref < nl)
@@ -430,14 +480,14 @@ def build_step(program: Program, opts: RuntimeOptions):
             # persists).
             remote_ok = has & ~ref_local & (st.rspill_count[0] == 0)
             slot_ok = ~has | local_ok | remote_ok
-            all_ok = jnp.all(slot_ok, axis=1)
+            all_ok = jnp.all(slot_ok, axis=0)
             # Overflowed ref sets (more distinct muters than slots) defer
             # to a shard-wide quiet condition — conservative, never early.
             shard_quiet = (jnp.max(occ0) <= opts.unmute_occ) \
                 & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0)
             release = st.muted & all_ok & (~st.mute_ovf | shard_quiet)
             return (st.muted & ~release,
-                    jnp.where(release[:, None], -1, refs),
+                    jnp.where(release[None, :], -1, refs),
                     st.mute_ovf & ~release)
 
         # Nobody muted (the common case) → skip the pass entirely.
@@ -492,13 +542,17 @@ def build_step(program: Program, opts: RuntimeOptions):
                 per = sd * sites
                 off = ch.spawn_offsets[tname]
                 widx = jnp.where(run_c, rank * per, 0)
-                idx = (off + widx[:, None]
-                       + jnp.arange(per, dtype=jnp.int32)[None, :])
+                # Planar [sd, sites, rows]: the per-(dispatch, site)
+                # offsets are the small major axes, actor lanes minor.
+                idx = (off + widx[None, None, :]
+                       + (jnp.arange(sd, dtype=jnp.int32)
+                          * sites)[:, None, None]
+                       + jnp.arange(sites, dtype=jnp.int32)[None, :, None])
                 rows = jnp.take(free_rows[tname], idx, mode="fill",
                                 fill_value=-1)
-                refs = jnp.where((rows >= 0) & run_c[:, None],
+                refs = jnp.where((rows >= 0) & run_c[None, None, :],
                                  base + rows, jnp.int32(-1))
-                resv[tname] = refs.reshape(ch.local_capacity, sd, sites)
+                resv[tname] = refs
             return resv
         new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
         head_segments: List[jnp.ndarray] = []
@@ -518,7 +572,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, sfail,
              dstr, errs) = run_cohort(
                 st.type_state[ch.atype.__name__],
-                st.buf[s0:s1], st.head[s0:s1], occ0[s0:s1],
+                st.buf[:, :, s0:s1], st.head[s0:s1], occ0[s0:s1],
                 runnable[s0:s1], ids, cohort_resv(ch))
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
@@ -573,7 +627,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             sender=jnp.concatenate([rspill_e.sender] +
                                    [o.sender for o in out_entries]),
             words=jnp.concatenate([rspill_e.words] +
-                                  [o.words for o in out_entries]),
+                                  [o.words for o in out_entries], axis=1),
         )
         route_muted = jnp.zeros((nl,), jnp.bool_)
         route_refs, route_ovf = empty_mute_slots(nl, opts.mute_slots)
@@ -606,7 +660,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                                     jnp.full_like(inj_local, -1),
                                     incoming.sender]),
             words=jnp.concatenate([dspill_e.words, inject_words,
-                                   incoming.words]),
+                                   incoming.words], axis=1),
         )
 
         prio_row = jnp.asarray(prio_row_np)
@@ -655,7 +709,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             new_head = new_head.at[rows].set(
                 jnp.take(new_tail, jnp.minimum(rows, nl - 1)), mode="drop")
             muted = muted.at[rows].set(False, mode="drop")
-            mute_refs = mute_refs.at[rows].set(-1, mode="drop")
+            mute_refs = mute_refs.at[:, rows].set(-1, mode="drop")
             mute_ovf = mute_ovf.at[rows].set(False, mode="drop")
             pinned = pinned.at[rows].set(False, mode="drop")
             n_destroyed = n_destroyed + jnp.sum(dstr.astype(jnp.int32))
@@ -668,14 +722,14 @@ def build_step(program: Program, opts: RuntimeOptions):
         def _merge_slots(a, b):
             both = (a >= 0) & (b >= 0)
             m = jnp.where(a < 0, b, jnp.where(b < 0, a, jnp.maximum(a, b)))
-            return m, jnp.any(both & (a != b), axis=1)
+            return m, jnp.any(both & (a != b), axis=0)
 
         newly = (res.newly_muted | route_muted) & alive
         inc_refs, c1 = _merge_slots(res.new_mute_refs, route_refs)
         merged_refs, c2 = _merge_slots(mute_refs, inc_refs)
         became_muted = newly & ~muted
         muted2 = muted | newly
-        mute_refs2 = jnp.where(newly[:, None], merged_refs, mute_refs)
+        mute_refs2 = jnp.where(newly[None, :], merged_refs, mute_refs)
         mute_ovf2 = jnp.where(
             newly, mute_ovf | res.new_mute_ovf | route_ovf | c1 | c2,
             mute_ovf)
@@ -853,11 +907,10 @@ def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
         return jax.jit(fn, donate_argnums=(0,))
 
     from jax.sharding import PartitionSpec as P
+    from .state import state_partition_specs
     assert mesh is not None, "sharded program needs a mesh"
-    sharded = P("actors")
     repl = P()
-    state_spec = jax.tree.map(lambda _: sharded,
-                              _state_structure(program, opts))
+    state_spec = state_partition_specs(program, opts)
     aux_spec = StepAux(*([repl] * len(StepAux._fields)))
     mapped = jax.shard_map(
         fn, mesh=mesh,

@@ -104,19 +104,20 @@ def build_gc(program: Program, opts: RuntimeOptions):
             jnp.where(roots, base + rows, ntot)].max(True, mode="drop")
         for tgt_arr, words_arr in (
                 (jnp.where(st.dspill_tgt >= 0, base + st.dspill_tgt, -1),
-                 st.dspill_words),
+                 st.dspill_words),                 # words planar [w1, S]
                 (st.rspill_tgt, st.rspill_words)):
             marks0 = marks0.at[jnp.where(tgt_arr >= 0, tgt_arr, ntot)].max(
                 True, mode="drop")
             if any_ref_args:
-                g = jnp.clip(words_arr[:, 0], 0, n_gids - 1)
-                rm = (jnp.asarray(ref_mask_np)[g]
-                      & (words_arr[:, :1] >= 0) & (words_arr[:, :1] < n_gids)
-                      & (tgt_arr[:, None] >= 0))
-                refs = jnp.where(rm, words_arr[:, 1:], -1)
-                marks0 = marks0.at[
-                    jnp.where(refs >= 0, refs, ntot).reshape(-1)].max(
-                    True, mode="drop")
+                gid = words_arr[0]
+                g = jnp.clip(gid, 0, n_gids - 1)
+                inr = (gid >= 0) & (gid < n_gids) & (tgt_arr >= 0)
+                for w in range(words_arr.shape[0] - 1):
+                    rm = jnp.asarray(ref_mask_np)[g, w] & inr
+                    refs = jnp.where(rm, words_arr[1 + w], -1)
+                    marks0 = marks0.at[
+                        jnp.where(refs >= 0, refs, ntot)].max(
+                        True, mode="drop")
 
         # Pre-extract edges (targets are global ids; sources are local).
         # State-field edges, one [local_cap] target column per Ref field.
@@ -126,17 +127,22 @@ def build_gc(program: Program, opts: RuntimeOptions):
                 col = st.type_state[cohort.atype.__name__][fname]
                 field_edges.append((cohort.local_start, cohort.local_stop,
                                     col.astype(jnp.int32)))
-        # Mailbox edges: ref args of queued messages, [nl, cap, W].
+        # Mailbox edges: ref args of queued messages. Planar over the
+        # [cap, w1, nl] table: ring slot ci holds a live message iff
+        # (ci - head) mod cap < occupancy; each payload word that the
+        # static ref mask marks contributes a [nl] target plane.
         if any_ref_args:
-            k = jnp.arange(cap, dtype=jnp.int32)
-            idx = (st.head[:, None] + k[None, :]) % cap
-            msgs = jnp.take_along_axis(st.buf, idx[:, :, None], axis=1)
-            valid = k[None, :] < occ[:, None]
-            g = jnp.clip(msgs[:, :, 0], 0, n_gids - 1)
-            inr = (msgs[:, :, 0] >= 0) & (msgs[:, :, 0] < n_gids)
-            rm = (jnp.asarray(ref_mask_np)[g]
-                  & valid[:, :, None] & inr[:, :, None])
-            mb_tgt = jnp.where(rm, msgs[:, :, 1:], -1)   # [nl, cap, W]
+            mb_planes = []                                # [nl] each
+            rmask = jnp.asarray(ref_mask_np)
+            for ci in range(cap):
+                valid = ((ci - st.head) % cap) < occ
+                gid = st.buf[ci, 0]
+                g = jnp.clip(gid, 0, n_gids - 1)
+                inr = valid & (gid >= 0) & (gid < n_gids)
+                for w in range(st.buf.shape[1] - 1):
+                    rm = rmask[g, w] & inr
+                    mb_planes.append(jnp.where(rm, st.buf[ci, 1 + w], -1))
+            mb_tgt = jnp.stack(mb_planes)                 # [cap*W, nl]
         else:
             mb_tgt = None
 
@@ -149,7 +155,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
                 marks = marks.at[jnp.where(src_ok, tgt, ntot)].max(
                     True, mode="drop")
             if mb_tgt is not None:
-                src_ok = live[:, None, None] & (mb_tgt >= 0)
+                src_ok = live[None, :] & (mb_tgt >= 0)
                 marks = marks.at[
                     jnp.where(src_ok, mb_tgt, ntot).reshape(-1)].max(
                     True, mode="drop")
@@ -190,7 +196,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             tail=st.tail,
             alive=st.alive & ~dead,
             muted=st.muted & ~dead,
-            mute_refs=jnp.where(dead[:, None], -1, st.mute_refs),
+            mute_refs=jnp.where(dead[None, :], -1, st.mute_refs),
             mute_ovf=st.mute_ovf & ~dead,
             pinned=st.pinned & ~dead,
             dspill_tgt=st.dspill_tgt, dspill_sender=st.dspill_sender,
@@ -228,11 +234,10 @@ def jit_gc(program: Program, opts: RuntimeOptions, mesh=None):
     if program.shards == 1:
         return jax.jit(gc, donate_argnums=(0,))
     from jax.sharding import PartitionSpec as P
-    from .engine import _state_structure
+    from .state import state_partition_specs
     sharded = P("actors")
     repl = P()
-    state_spec = jax.tree.map(lambda _: sharded,
-                              _state_structure(program, opts))
+    state_spec = state_partition_specs(program, opts)
     mapped = jax.shard_map(
         gc, mesh=mesh,
         in_specs=(state_spec, sharded),

@@ -183,7 +183,7 @@ class Runtime:
         w1 = 1 + self.opts.msg_words
         k = self.opts.inject_slots
         self._empty_inject = (jnp.full((k,), -1, jnp.int32),
-                              jnp.zeros((k, w1), jnp.int32))
+                              jnp.zeros((w1, k), jnp.int32))
         for cohort in self.program.cohorts:
             self._free[cohort.atype.__name__] = list(
                 range(cohort.capacity - 1, -1, -1))
@@ -421,8 +421,10 @@ class Runtime:
                 f"(first target {int(full[0])}); drain with run() first or "
                 "raise mailbox_cap")
         slot = t_at % self.opts.mailbox_cap
+        # buf is [cap, w1, N] (actor-lane minor; state.py layout note):
+        # advanced indices (slot, target) pair up, the word axis rides.
         self.state = self._replace(
-            buf=self.state.buf.at[targets, slot].set(jnp.asarray(words)),
+            buf=self.state.buf.at[slot, :, targets].set(jnp.asarray(words)),
             tail=tail.at[targets].add(1))
 
     def _drain_inject(self):
@@ -431,7 +433,7 @@ class Runtime:
         k = self.opts.inject_slots
         w1 = 1 + self.opts.msg_words
         tgt = np.full((k,), -1, np.int32)
-        words = np.zeros((k, w1), np.int32)
+        words = np.zeros((w1, k), np.int32)   # planar: word-major
         # Host-side flow control: at most one drain-batch per target per
         # step, so a burst (e.g. timer events queued during a long XLA
         # compile) can never outrun the receiver and trip the bounded
@@ -453,7 +455,7 @@ class Runtime:
                 continue
             taken[t] = c + 1
             tgt[i] = t
-            words[i] = w
+            words[:, i] = w
             i += 1
         self._inject_q.extendleft(reversed(held))
         return jnp.asarray(tgt), jnp.asarray(words)
@@ -537,7 +539,7 @@ class Runtime:
         pending = tail - head
         if not pending.any():
             return False
-        buf = np.asarray(self.state.buf[rows_j])
+        buf = np.asarray(self.state.buf[:, :, rows_j])  # [cap, w1, R]
         c = self.opts.mailbox_cap
         new_head = head.copy()
         for i in np.nonzero(pending)[0]:
@@ -545,7 +547,7 @@ class Runtime:
             cohort = self.program.cohort_of(aid)
             consumed = 0
             for k in range(int(pending[i])):
-                msg = buf[i, (head[i] + k) % c]
+                msg = buf[(head[i] + k) % c, :, i]
                 consumed += 1
                 gid = int(msg[0])
                 bdef = (self.program.behaviour_table[gid]
@@ -703,7 +705,7 @@ class Runtime:
         alive = np.asarray(st.alive)
         muted = np.asarray(st.muted)
         assert not (muted & ~alive).any(), "dead actor still muted"
-        assert (np.asarray(st.mute_refs)[~muted] == -1).all(), \
+        assert (np.asarray(st.mute_refs)[:, ~muted] == -1).all(), \
             "unmuted actor holds a mute ref"
         dead_occ = occ[~alive]
         assert (dead_occ == 0).all(), "dead actor with queued messages"

@@ -11,11 +11,20 @@
     analog, so overflow goes to bounded *spill* tables retried next step
     (SURVEY.md §7 hard part (a): capacity-bounded mailboxes with spill).
 
-Every array is laid out so its leading axis shards over the actor-axis
-mesh (`shards` = P): actor rows are shard-major (see program.py), per-shard
-scalars are [P] vectors, and the two spill tables are per-shard [P*S]. With
-P == 1 this is exactly the single-chip layout. Two spills exist because a
-message can be stuck in two different places on a mesh:
+TPU-first memory layout (the round-3 redesign): the actor/entry axis is
+the MINOR-MOST (last) dimension of every multi-dimensional array. XLA:TPU
+maps the last dim onto the 128 vector lanes and pads it up — a
+[N, cap, words] mailbox table (actor-major, the CPU-obvious layout) pads
+its `words`-sized minor dim to 128 lanes, inflating physical traffic up
+to 64× and making the dispatch/delivery path run at ~1/30 of HBM speed
+(measured on-chip, round 3). With [cap, words, N] the million-actor axis
+fills the lanes, small static dims (ring slot, payload word) become the
+major axes iterated at trace time, and every hot op is a full-width
+vector op over [N]. Sharding therefore also rides the LAST axis (see
+state_partition_specs): actor rows are shard-major within it
+(program.py), per-shard scalars are [P] vectors, spill tables per-shard
+[P*S]. With P == 1 this is exactly the single-chip layout. Two spills
+exist because a message can be stuck in two different places on a mesh:
 
   - rspill ("route spill", sender side): the per-destination all_to_all
     bucket was full — the message hasn't left its source shard yet; targets
@@ -72,15 +81,17 @@ def layout_sizes(program: Program, opts: RuntimeOptions):
 class RtState:
     """The complete device state of the actor world (one pytree)."""
 
-    # Mailboxes (≙ messageq.c): one row per actor, device and host cohorts.
-    buf: jnp.ndarray          # [N, cap, 1+W] int32 — word0 = behaviour gid
+    # Mailboxes (≙ messageq.c): one lane per actor, device and host
+    # cohorts; ring slot and payload word are the (small, static) major
+    # axes — see the layout note in the module docstring.
+    buf: jnp.ndarray          # [cap, 1+W, N] int32 — word0 = behaviour gid
     head: jnp.ndarray         # [N] int32, monotonic pop count
     tail: jnp.ndarray         # [N] int32, monotonic push count
 
     # Per-actor scheduling flags (≙ actor.h:59-69 flag bits).
     alive: jnp.ndarray        # [N] bool — slot occupied (≙ !PENDINGDESTROY)
     muted: jnp.ndarray        # [N] bool — ≙ FLAG_MUTED; skipped by dispatch
-    mute_refs: jnp.ndarray    # [N, K] int32 — global ids of the muting
+    mute_refs: jnp.ndarray    # [K, N] int32 — global ids of the muting
     #                              receivers (possibly off-shard), slotted
     #                              by ref % K; -1 = empty slot. ≙ the
     #                              mutemap receiver-set per sender
@@ -95,13 +106,13 @@ class RtState:
     # Receiver-side overflow spill (local-row targets).
     dspill_tgt: jnp.ndarray    # [P*S] int32 local row, -1 = empty slot
     dspill_sender: jnp.ndarray  # [P*S] int32 sender *global* id (-1 = host)
-    dspill_words: jnp.ndarray  # [P*S, 1+W] int32
+    dspill_words: jnp.ndarray  # [1+W, P*S] int32
     dspill_count: jnp.ndarray  # [P] int32
 
     # Sender-side routing spill (global-id targets; used when P > 1).
     rspill_tgt: jnp.ndarray    # [P*S] int32 global id, -1 = empty slot
     rspill_sender: jnp.ndarray  # [P*S] int32 sender global id
-    rspill_words: jnp.ndarray  # [P*S, 1+W] int32
+    rspill_words: jnp.ndarray  # [1+W, P*S] int32
     rspill_count: jnp.ndarray  # [P] int32
 
     spill_overflow: jnp.ndarray  # [P] bool — a spill overflowed (fatal)
@@ -166,21 +177,21 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         type_state[cohort.atype.__name__] = fields
 
     return RtState(
-        buf=jnp.zeros((n, c, w1), i32),
+        buf=jnp.zeros((c, w1, n), i32),
         head=jnp.zeros((n,), i32),
         tail=jnp.zeros((n,), i32),
         alive=jnp.zeros((n,), jnp.bool_),
         muted=jnp.zeros((n,), jnp.bool_),
-        mute_refs=jnp.full((n, opts.mute_slots), -1, i32),
+        mute_refs=jnp.full((opts.mute_slots, n), -1, i32),
         mute_ovf=jnp.zeros((n,), jnp.bool_),
         pinned=jnp.zeros((n,), jnp.bool_),
         dspill_tgt=jnp.full((s,), -1, i32),
         dspill_sender=jnp.full((s,), -1, i32),
-        dspill_words=jnp.zeros((s, w1), i32),
+        dspill_words=jnp.zeros((w1, s), i32),
         dspill_count=jnp.zeros((p,), i32),
         rspill_tgt=jnp.full((s,), -1, i32),
         rspill_sender=jnp.full((s,), -1, i32),
-        rspill_words=jnp.zeros((s, w1), i32),
+        rspill_words=jnp.zeros((w1, s), i32),
         rspill_count=jnp.zeros((p,), i32),
         spill_overflow=jnp.zeros((p,), jnp.bool_),
         exit_flag=jnp.zeros((p,), jnp.bool_),
@@ -203,3 +214,14 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),
         type_state=type_state,
     )
+
+
+def state_partition_specs(program: Program, opts: RuntimeOptions):
+    """PartitionSpec pytree matching RtState: every array shards its
+    LAST axis over the 'actors' mesh axis (the lane/actor dimension —
+    see the layout note above); leading static dims replicate."""
+    from jax.sharding import PartitionSpec as P
+    shapes = jax.eval_shape(lambda: init_state(program, opts))
+    return jax.tree.map(
+        lambda leaf: P(*([None] * (len(leaf.shape) - 1) + ["actors"])),
+        shapes)

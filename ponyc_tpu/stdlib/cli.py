@@ -72,8 +72,11 @@ class _Spec:
 
 
 def _make_ctors(cls, seq_types=("string_seq",)):
-    for typ, default in (("bool", False), ("string", ""), ("i64", 0),
-                         ("u64", 0), ("f64", 0.0)):
+    # As in the reference (command_spec.pony bool/string/i64/u64/f64
+    # constructors take `default': (A | None) = None`): omitting the
+    # default makes the option/arg REQUIRED; pass default= to make it
+    # optional.
+    for typ in ("bool", "string", "i64", "u64", "f64"):
         def ctor(name, descr="", short=None, default=None, required=False,
                  _t=typ):
             return cls(name, descr, _t, default,
@@ -251,9 +254,8 @@ class CommandParser:
         self.envs = envs
 
     def parse(self, argv: Sequence[str]):
-        if not argv or argv[0].split("/")[-1] != self.spec.name_:
-            # Tolerate argv[0] being a path to the program.
-            pass
+        # argv[0] is the program name/path and is not validated (the
+        # reference parses from argv[1:] the same way).
         return self._parse(self.spec, list(argv[1:]), [], {},
                            self.spec.name_)
 

@@ -20,8 +20,11 @@ __all__ = ["Iter"]
 class Iter:
     """≙ iter.pony Iter[A]."""
 
+    _NONE = object()        # sentinel: no peeked value buffered
+
     def __init__(self, it: Iterable):
         self._it = iter(it)
+        self._peeked = Iter._NONE
 
     # -- constructors --
     @staticmethod
@@ -34,38 +37,49 @@ class Iter:
 
     # -- protocol --
     def __iter__(self) -> Iterator:
-        return self._it
+        while True:
+            try:
+                yield self.next()
+            except StopIteration:
+                return
 
     def has_next(self) -> bool:
+        if self._peeked is not Iter._NONE:
+            return True
         try:
-            v = next(self._it)
+            self._peeked = next(self._it)
         except StopIteration:
             return False
-        self._it = _it.chain([v], self._it)
         return True
 
     def next(self):
+        if self._peeked is not Iter._NONE:
+            v = self._peeked
+            self._peeked = Iter._NONE
+            return v
         return next(self._it)
+
+    __next__ = next         # Iter is itself a Python iterator
 
     # -- terminal ops --
     def all(self, f: Callable[[Any], bool]) -> bool:
-        return all(f(x) for x in self._it)
+        return all(f(x) for x in self)
 
     def any(self, f: Callable[[Any], bool]) -> bool:
-        return any(f(x) for x in self._it)
+        return any(f(x) for x in self)
 
     def collect(self, coll: Optional[list] = None) -> list:
         coll = coll if coll is not None else []
-        coll.extend(self._it)
+        coll.extend(self)
         return coll
 
     def count(self) -> int:
-        return sum(1 for _ in self._it)
+        return sum(1 for _ in self)
 
     def find(self, f: Callable[[Any], bool], n: int = 1):
         """The n-th element satisfying f; raises IndexError (≙ error)."""
         seen = 0
-        for x in self._it:
+        for x in self:
             if f(x):
                 seen += 1
                 if seen == n:
@@ -73,13 +87,13 @@ class Iter:
         raise IndexError("find: no match")
 
     def fold(self, acc, f: Callable[[Any, Any], Any]):
-        for x in self._it:
+        for x in self:
             acc = f(acc, x)
         return acc
 
     def last(self):
         out = _SENTINEL = object()
-        for out in self._it:
+        for out in self:
             pass
         if out is _SENTINEL:
             raise IndexError("last of empty Iter")
@@ -87,7 +101,7 @@ class Iter:
 
     def nth(self, n: int):
         """1-based n-th element (≙ iter.pony nth); IndexError past end."""
-        for i, x in enumerate(self._it, 1):
+        for i, x in enumerate(self, 1):
             if i == n:
                 return x
         raise IndexError(n)
@@ -95,7 +109,7 @@ class Iter:
     def run(self, on_error: Optional[Callable[[], None]] = None) -> None:
         """Drain the iterator for its effects (≙ iter.pony run)."""
         try:
-            for _ in self._it:
+            for _ in self:
                 pass
         except Exception:
             if on_error is not None:
@@ -108,35 +122,35 @@ class Iter:
         return Iter(gen)
 
     def cycle(self) -> "Iter":
-        return self._wrap(_it.cycle(self._it))
+        return self._wrap(_it.cycle(self))
 
     def dedup(self) -> "Iter":
         """Drop *all* duplicates, keeping first occurrence
         (≙ iter.pony dedup — hash-set based, unlike unique)."""
         def gen():
             seen = set()
-            for x in self._it:
+            for x in self:
                 if x not in seen:
                     seen.add(x)
                     yield x
         return self._wrap(gen())
 
     def enum(self) -> "Iter":
-        return self._wrap(((i, x) for i, x in enumerate(self._it)))
+        return self._wrap(((i, x) for i, x in enumerate(self)))
 
     def filter(self, f) -> "Iter":
-        return self._wrap((x for x in self._it if f(x)))
+        return self._wrap((x for x in self if f(x)))
 
     def filter_map(self, f) -> "Iter":
-        return self._wrap((y for x in self._it
+        return self._wrap((y for x in self
                            if (y := f(x)) is not None))
 
     def flat_map(self, f) -> "Iter":
-        return self._wrap((y for x in self._it for y in f(x)))
+        return self._wrap((y for x in self for y in f(x)))
 
     def interleave(self, other: Iterable) -> "Iter":
         def gen():
-            a, b = self._it, iter(other)
+            a, b = self, iter(other)
             while True:
                 stop = 0
                 for src in (a, b):
@@ -149,32 +163,32 @@ class Iter:
         return self._wrap(gen())
 
     def map(self, f) -> "Iter":
-        return self._wrap((f(x) for x in self._it))
+        return self._wrap((f(x) for x in self))
 
     def skip(self, n: int) -> "Iter":
-        return self._wrap(_it.islice(self._it, n, None))
+        return self._wrap(_it.islice(self, n, None))
 
     def skip_while(self, f) -> "Iter":
-        return self._wrap(_it.dropwhile(f, self._it))
+        return self._wrap(_it.dropwhile(f, self))
 
     def step_by(self, n: int) -> "Iter":
-        return self._wrap(_it.islice(self._it, 0, None, max(1, n)))
+        return self._wrap(_it.islice(self, 0, None, max(1, n)))
 
     def take(self, n: int) -> "Iter":
-        return self._wrap(_it.islice(self._it, n))
+        return self._wrap(_it.islice(self, n))
 
     def take_while(self, f) -> "Iter":
-        return self._wrap(_it.takewhile(f, self._it))
+        return self._wrap(_it.takewhile(f, self))
 
     def unique(self) -> "Iter":
         """Drop *consecutive* duplicates (≙ iter.pony unique)."""
         def gen():
             prev = object()
-            for x in self._it:
+            for x in self:
                 if x != prev:
                     yield x
                 prev = x
         return self._wrap(gen())
 
     def zip(self, *others: Iterable) -> "Iter":
-        return self._wrap(zip(self._it, *map(iter, others)))
+        return self._wrap(zip(self, *map(iter, others)))

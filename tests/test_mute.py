@@ -21,9 +21,10 @@ def test_mute_ref_slots_distinct_refs():
     rows = jnp.array([1, 1, 0], jnp.int32)
     refs = jnp.array([5, 6, 7], jnp.int32)      # 5%4=1, 6%4=2: no collision
     table, ovf = mute_ref_slots(trig, rows, refs, n=n, k=k)
-    assert table[1, 1] == 5 and table[1, 2] == 6
+    # table is [K slots, n senders] (planar; state.py layout note)
+    assert table[1, 1] == 5 and table[2, 1] == 6
     assert not bool(ovf.any())
-    assert (np.asarray(table)[0] == -1).all()   # untriggered row empty
+    assert (np.asarray(table)[:, 0] == -1).all()  # untriggered sender empty
 
 
 def test_mute_ref_slots_collision_sets_overflow():
@@ -33,7 +34,7 @@ def test_mute_ref_slots_collision_sets_overflow():
     refs = jnp.array([3, 7], jnp.int32)         # both % 4 == 3: collide
     table, ovf = mute_ref_slots(trig, rows, refs, n=n, k=k)
     assert bool(ovf[0]) and not bool(ovf[1])
-    assert table[0, 3] == 7                     # max kept
+    assert table[3, 0] == 7                     # max kept
 
 
 def test_mute_ref_slots_same_ref_twice_no_overflow():
@@ -43,7 +44,7 @@ def test_mute_ref_slots_same_ref_twice_no_overflow():
     refs = jnp.array([7, 7], jnp.int32)         # same receiver twice
     table, ovf = mute_ref_slots(trig, rows, refs, n=n, k=k)
     assert not bool(ovf.any())
-    assert table[0, 3] == 7
+    assert table[3, 0] == 7
 
 
 @actor
@@ -135,7 +136,7 @@ def test_release_only_after_all_refs_recover():
         if prev is not None:
             released = prev["muted"] & ~muted
             for a in np.nonzero(released)[0]:
-                rs = prev["refs"][a]
+                rs = prev["refs"][:, a]
                 rs = rs[rs >= 0]
                 if prev["ovf"][a]:
                     assert (prev["occ"] <= opts.unmute_occ).all()
