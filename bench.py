@@ -91,6 +91,7 @@ def force_cpu():
 
 def bench_ubench(args):
     import jax
+    import jax.numpy as jnp
     from ponyc_tpu import RuntimeOptions
     from ponyc_tpu.models import ubench
 
@@ -103,29 +104,39 @@ def bench_ubench(args):
     ubench.seed_all(rt, ids, hops=1 << 30)   # effectively infinite
     build_s = time.time() - t0
 
-    # Drive the jitted tick directly (the run() loop's quiescence polling
-    # is for applications; the bench measures the engine's steady state).
+    # Drive the fused window directly (engine.build_multi_step): one
+    # device dispatch advances `fuse` ticks, so the measurement sees the
+    # engine's steady state, not per-dispatch overhead. ubench never
+    # quiesces, so every window runs its full `fuse` ticks (asserted via
+    # the processed counter below).
+    K = max(1, min(args.fuse, args.ticks))   # small --ticks shrinks windows
+    limit = jnp.int32(K)
     inj = rt._empty_inject
     state = rt.state
     t0 = time.time()
-    for _ in range(args.warmup):
-        state, aux = rt._step(state, *inj)
+    warm_windows = -(-args.warmup // K)      # warmup >= 1 (main() clamps)
+    for _ in range(warm_windows):
+        state, aux, _k = rt._multi(state, *inj, limit)
     jax.block_until_ready(aux)
     warm_s = time.time() - t0
 
+    windows = max(1, args.ticks // K)
+    ticks = windows * K
     t0 = time.time()
-    for _ in range(args.ticks):
-        state, aux = rt._step(state, *inj)
+    for _ in range(windows):
+        state, aux, _k = rt._multi(state, *inj, limit)
     jax.block_until_ready(aux)
     elapsed = time.time() - t0
     rt.state = state
 
     processed = rt.counter("n_processed") & 0xFFFFFFFF
-    expect = (args.warmup + args.ticks) * args.actors
+    expect = (warm_windows * K + ticks) * args.actors
     return {
-        "msgs_per_sec": args.actors * args.ticks / elapsed,
+        "msgs_per_sec": args.actors * ticks / elapsed,
         "elapsed_s": elapsed,
-        "tick_ms": 1e3 * elapsed / args.ticks,
+        "tick_ms": 1e3 * elapsed / ticks,
+        "ticks": ticks,
+        "fuse": K,
         "processed_counter_ok": bool(processed == expect % (1 << 32)),
         "build_s": build_s,
         "warmup_s": warm_s,
@@ -173,8 +184,10 @@ def main():
                     default=int(os.environ.get("PONY_TPU_BENCH_ACTORS",
                                                1 << 20)))
     ap.add_argument("--ticks", type=int,
-                    default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 200)))
-    ap.add_argument("--warmup", type=int, default=20)
+                    default=int(os.environ.get("PONY_TPU_BENCH_TICKS", 256)))
+    ap.add_argument("--fuse", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_FUSE", 64)))
+    ap.add_argument("--warmup", type=int, default=64)
     ap.add_argument("--cap", type=int,
                     default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
     ap.add_argument("--lat-actors", type=int, default=1024)
@@ -222,7 +235,8 @@ def main():
         "vs_baseline": round(msgs_per_sec / CPU32_BASELINE_MSGS_PER_SEC, 3),
         "detail": {
             "actors": args.actors,
-            "ticks": args.ticks,
+            "ticks": ub["ticks"],
+            "fused_ticks_per_dispatch": ub["fuse"],
             "elapsed_s": round(ub["elapsed_s"], 4),
             "tick_ms": round(ub["tick_ms"], 3),
             "processed_counter_ok": ub["processed_counter_ok"],

@@ -162,7 +162,7 @@ class Context:
     __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
                  "spawn_claims", "destroy_called", "error_flag",
-                 "error_code", "error_called")
+                 "error_code", "error_called", "ref_types")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None):
         self.actor_id = actor_id          # traced i32 scalar (global id)
@@ -183,6 +183,9 @@ class Context:
         # {target type name: [claimed refs so far]} (engine canonicalises).
         self.spawn_claims: Dict[str, List[Any]] = {
             t: [] for t in self._spawn_resv}
+        # Trace-time typed-ref provenance; the engine tags the typed
+        # state fields and typed args into it before dispatch.
+        self.ref_types = pack.RefTypes()
 
     # -- messaging (≙ pony_sendv, actor.c:773-834) --
     def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
@@ -192,6 +195,26 @@ class Context:
         if behaviour_def.global_id is None:
             raise RuntimeError(
                 f"{behaviour_def} not registered in a Program yet")
+        # Sendability checks (≙ type/safeto.c + expr/call.c: a behaviour
+        # call must exist on the receiver's type, and ref-typed params
+        # only accept matching refs). Typed provenance rides on tracer
+        # identity (pack.RefTypes) — a directly-forwarded typed field or
+        # argument is checked; derived values are untyped (gradual).
+        # Fails the TRACE (build time), not as a runtime badmsg.
+        owner = behaviour_def.actor_type.__name__
+        tn = self.ref_types.lookup(target)
+        if tn is not None and tn != owner:
+            raise TypeError(
+                f"sendability: ref typed Ref[{tn}] cannot receive "
+                f"{owner}.{behaviour_def.name} — declare the field/arg "
+                f"as Ref[{owner}] or fix the wiring")
+        for spec, a in zip(behaviour_def.arg_specs, args):
+            want = pack.ref_target(spec)
+            got = self.ref_types.lookup(a)
+            if want is not None and got is not None and got != want:
+                raise TypeError(
+                    f"sendability: {owner}.{behaviour_def.name} expects "
+                    f"Ref[{want}] but was passed a Ref[{got}]")
         payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
         # Planar-aware: payload is [W] (all-constant args) or [W, R]
         # (lane vectors); the gid row matches its trailing shape.
@@ -236,7 +259,10 @@ class Context:
         self.spawn_claims[tname].append(jnp.where(ok, ref, jnp.int32(-1)))
         self.spawn_fail = self.spawn_fail | (w & (ref < 0))
         self.send(ref, ctor, *args, when=ok)
-        return jnp.where(ok, ref, jnp.int32(-1))
+        # The returned ref is typed (provenance-tagged): storing it in a
+        # mistyped Ref[T] field or sending it a foreign behaviour fails
+        # at build.
+        return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
 
     def destroy(self, when=True):
         """Mark *this* actor for destruction at the end of the step: slot

@@ -27,8 +27,9 @@ _SPEC_NAMES = {pack.I32: "I32", pack.F32: "F32", pack.Bool: "Bool",
 
 
 def _sig(bdef) -> str:
-    args = ", ".join(f"{n}: {_SPEC_NAMES.get(s, '?')}"
-                     for n, s in zip(bdef.arg_names, bdef.arg_specs))
+    args = ", ".join(
+        f"{n}: {_SPEC_NAMES.get(s, getattr(s, '__name__', '?'))}"
+        for n, s in zip(bdef.arg_names, bdef.arg_specs))
     return f"{bdef.name}({args})"
 
 
@@ -55,7 +56,7 @@ def document_type(atype: ActorTypeMeta) -> str:
     if atype.field_specs:
         lines += ["| field | type |", "|---|---|"]
         for fname, spec in atype.field_specs.items():
-            lines.append(f"| {fname} | {_SPEC_NAMES.get(spec, '?')} |")
+            lines.append(f"| {fname} | {_SPEC_NAMES.get(spec, getattr(spec, '__name__', '?'))} |")
         lines.append("")
     for bdef in atype.behaviour_defs:
         lines.append(f"### be {_sig(bdef)}")

@@ -17,7 +17,7 @@ from .. import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
 
 @actor
 class RingNode:
-    next_ref: Ref
+    next_ref: Ref[RingNode]   # typed: wiring checked at build (pack._RefTo)
     passes: I32     # hops observed by this node (for verification)
 
     @behaviour

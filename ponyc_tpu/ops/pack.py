@@ -27,15 +27,87 @@ class Bool:
     """Marker annotation: boolean argument."""
 
 
+class _RefTo:
+    """A typed actor-reference annotation: Ref[SomeActor].
+
+    ≙ the reference type system's *typed* actor references — the compiler
+    knows every ref's receiving type (type/cap.c, type/subtype.c) and
+    rejects sends the type can't receive (expr/call.c). Here the
+    sendability checker (see api.Context.send and Runtime.send) enforces
+    the same wiring rule at trace/build time instead of badmsg-ing at
+    runtime. `target` may be the actor class or its name (forward ref)."""
+
+    __slots__ = ("target",)
+
+    def __init__(self, target):
+        self.target = target
+
+    @property
+    def target_name(self) -> str:
+        t = self.target
+        return t if isinstance(t, str) else t.__name__
+
+    @property
+    def __name__(self) -> str:      # for structural fingerprints
+        return f"Ref[{self.target_name}]"
+
+    def __repr__(self):
+        return self.__name__
+
+
 class Ref:
-    """Marker annotation: actor reference (global actor id, i32)."""
+    """Marker annotation: actor reference (global actor id, i32).
+
+    Bare `Ref` is untyped (gradual — no wiring check); `Ref[SomeActor]`
+    is typed and send/spawn wiring is verified (see _RefTo)."""
+
+    def __class_getitem__(cls, item):
+        return _RefTo(item)
+
+
+def is_ref(ann) -> bool:
+    return ann is Ref or isinstance(ann, _RefTo)
+
+
+def ref_target(ann):
+    """The declared target type name of a typed ref, else None."""
+    return ann.target_name if isinstance(ann, _RefTo) else None
+
+
+class RefTypes:
+    """Trace-time provenance map: traced-array object → declared ref type.
+
+    Typed refs stay PLAIN int32 arrays (so every jnp op works untouched);
+    the type tag rides on the tracer's *identity*. A behaviour that
+    forwards st['out'] or a Ref[T] argument unchanged keeps its type; any
+    derived value (jnp.where, arithmetic) is simply untyped again —
+    checking is gradual, and can never break user array code.
+
+    Entries hold a strong reference to the tagged object so its id cannot
+    be recycled within the trace."""
+
+    __slots__ = ("_m",)
+
+    def __init__(self):
+        self._m = {}          # id(obj) → (obj, target_name)
+
+    def tag(self, obj, target_name):
+        if target_name is not None:
+            self._m[id(obj)] = (obj, target_name)
+        return obj
+
+    def lookup(self, obj):
+        ent = self._m.get(id(obj))
+        return ent[1] if ent is not None else None
 
 
 _MARKERS = (I32, F32, Bool, Ref)
 
 
 def normalize_annotation(ann):
-    """Map a user annotation to one of the marker classes."""
+    """Map a user annotation to a marker class (or typed-ref instance)."""
+    if isinstance(ann, _RefTo):
+        return ann
     if ann in _MARKERS:
         return ann
     if ann in (int, jnp.int32, "int", "I32", "i32"):
@@ -46,6 +118,8 @@ def normalize_annotation(ann):
         return Bool
     if ann in ("Ref", "ActorRef"):
         return Ref
+    if isinstance(ann, str) and ann.startswith("Ref[") and ann.endswith("]"):
+        return _RefTo(ann[4:-1].strip().strip("'\""))
     raise TypeError(f"unsupported behaviour argument annotation: {ann!r}")
 
 
@@ -59,7 +133,8 @@ def pack_arg(ann, value):
 
 
 def unpack_arg(ann, word):
-    """Decode one int32 word back to its annotated type."""
+    """Decode one int32 word back to its annotated type. (Typed-ref args
+    stay plain arrays; the caller tags them in a RefTypes map.)"""
     if ann is F32:
         return word.view(jnp.float32)
     if ann is Bool:

@@ -147,6 +147,27 @@ class Program:
                 bdef.local_id = local
                 self.behaviour_table.append(bdef)
                 gid += 1
+        # Verify pass (≙ the compiler's post-typecheck verify/, and
+        # type/safeto.c's sendability): every typed Ref[T] field or
+        # behaviour argument must name a type declared in this program —
+        # a miswired program fails HERE, at build, not as runtime badmsg.
+        from .ops.pack import ref_target
+        declared = {c.atype.__name__ for c in self.cohorts}
+        for cohort in self.cohorts:
+            for fname, spec in cohort.atype.field_specs.items():
+                t = ref_target(spec)
+                if t is not None and t not in declared:
+                    raise TypeError(
+                        f"{cohort.atype.__name__}.{fname} is Ref[{t}] but "
+                        f"{t} is not declared in this program")
+            for b in cohort.behaviours:
+                for i, spec in enumerate(b.arg_specs):
+                    t = ref_target(spec)
+                    if t is not None and t not in declared:
+                        raise TypeError(
+                            f"{cohort.atype.__name__}.{b.name} arg "
+                            f"{b.arg_names[i]!r} is Ref[{t}] but {t} is "
+                            "not declared in this program")
         self._resolve_spawns()
         self.frozen = True
         from . import plugin as _plugin

@@ -99,7 +99,7 @@ def _bcast_lanes(v, dtype, lanes: int):
 
 
 def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
-                 spawn_sites, effects, lanes: int):
+                 field_specs, spawn_sites, effects, lanes: int):
     """Wrap one behaviour as a *planar* evaluator: it runs on ALL `lanes`
     actors of the cohort at once (state fields, args, and effect masks
     are [lanes] vectors) and the dispatcher selects its outputs where the
@@ -117,6 +117,15 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
     def branch(st, payload, ids_vec, resv_k):
         ctx = Context(ids_vec, msg_words, spawn_resv=resv_k)
         args = pack.unpack_args(bdef.arg_specs, payload)
+        # Typed Ref[T] state fields and args enter the behaviour as PLAIN
+        # arrays whose trace-time identity is tagged with the declared
+        # type (pack.RefTypes), so Context.send verifies wiring at trace
+        # time (≙ type/safeto.c sendability; the verify pass of the
+        # build) without touching how refs behave under jnp ops.
+        for k, v in st.items():
+            ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
+        for spec, a in zip(bdef.arg_specs, args):
+            ctx.ref_types.tag(a, pack.ref_target(spec))
         st2 = bdef.fn(ctx, dict(st), *args)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
         effects["error"] = effects["error"] or ctx.error_called
@@ -128,6 +137,13 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
             raise TypeError(
                 f"behaviour {bdef} changed the state fields: "
                 f"{sorted(st2)} vs {sorted(st)}")
+        for k, v in st2.items():
+            want = pack.ref_target(field_specs[k])
+            got = ctx.ref_types.lookup(v)
+            if want is not None and got is not None and got != want:
+                raise TypeError(
+                    f"sendability: behaviour {bdef} stores a Ref[{got}] "
+                    f"into field {k!r} declared Ref[{want}]")
         st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
                for k, v in st2.items()}
         if len(ctx.sends) > max_sends:
@@ -186,7 +202,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                                else jnp.int32)
     spawn_sites = tuple(sorted(cohort.spawns.items()))
     effects = {"destroy": False, "error": False}
-    branches = [_make_branch(b, msg_words, ms, field_dtypes, spawn_sites,
+    branches = [_make_branch(b, msg_words, ms, field_dtypes,
+                             cohort.atype.field_specs, spawn_sites,
                              effects, rows)
                 for b in cohort.behaviours]
     nb = len(cohort.behaviours)
@@ -614,7 +631,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                              tc.local_capacity)
             ts = dict(new_type_state[tname])
             for fname in ts:
-                default = (-1 if tc.atype.field_specs[fname] is pack.Ref
+                default = (-1 if pack.is_ref(tc.atype.field_specs[fname])
                            else 0)
                 ts[fname] = ts[fname].at[cols].set(default, mode="drop")
             new_type_state[tname] = ts

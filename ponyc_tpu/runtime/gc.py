@@ -56,19 +56,20 @@ def build_ref_arg_mask(program: Program, msg_words: int) -> np.ndarray:
     """Static [n_gids, msg_words] bool: which payload words of each
     behaviour message are actor refs (≙ the per-type trace function the
     compiler emits, gentrace.c — here derived from Ref annotations)."""
-    from ..ops.pack import Ref
+    from ..ops.pack import is_ref
     n = len(program.behaviour_table)
     mask = np.zeros((max(n, 1), msg_words), bool)
     for gid, bdef in enumerate(program.behaviour_table):
         for i, spec in enumerate(bdef.arg_specs):
-            if spec is Ref and i < msg_words:
+            if is_ref(spec) and i < msg_words:
                 mask[gid, i] = True
     return mask
 
 
 def _ref_fields(cohort):
-    from ..ops.pack import Ref
-    return [f for f, spec in cohort.atype.field_specs.items() if spec is Ref]
+    from ..ops.pack import is_ref
+    return [f for f, spec in cohort.atype.field_specs.items()
+            if is_ref(spec)]
 
 
 def build_gc(program: Program, opts: RuntimeOptions):

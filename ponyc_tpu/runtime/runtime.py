@@ -209,6 +209,7 @@ class Runtime:
         unknown = set(fields) - set(atype.field_specs)
         if unknown:
             raise TypeError(f"{atype.__name__} has no fields {unknown}")
+        self._check_ref_fields(atype, fields)
         if not cohort.host and (self.program.has_device_spawns
                                 or self.steps_run):
             # Device-side spawn/destroy/GC may have claimed or freed slots
@@ -231,7 +232,7 @@ class Runtime:
             for i, gid in enumerate(ids):
                 st = {}
                 for fname in atype.field_specs:
-                    default = (-1 if atype.field_specs[fname] is pack.Ref
+                    default = (-1 if pack.is_ref(atype.field_specs[fname])
                                else 0)
                     v = fields.get(fname, default)
                     v = np.asarray(v)
@@ -247,7 +248,8 @@ class Runtime:
                                            else val.shape)
                 else:
                     # Reused slots must not leak a previous life's state.
-                    val = jnp.full((count,), -1 if spec is pack.Ref else 0,
+                    val = jnp.full((count,),
+                                   -1 if pack.is_ref(spec) else 0,
                                    ts[fname].dtype)
                 ts[fname] = ts[fname].at[cols].set(val)
             new_ts = dict(self.state.type_state)
@@ -330,7 +332,7 @@ class Runtime:
         for aid, stt in self._host_state.items():
             cohort = self.program.cohort_of(aid)
             for fname, spec in cohort.atype.field_specs.items():
-                if spec is pack.Ref:
+                if pack.is_ref(spec):
                     v = int(stt.get(fname, -1))
                     if 0 <= v < self.program.total:
                         extra[v] = True
@@ -359,6 +361,7 @@ class Runtime:
         """Overwrite state columns for existing actors (host-side poke,
         e.g. wiring refs once ids are known). ids are global actor ids."""
         cohort = self.program.by_type[atype]
+        self._check_ref_fields(atype, fields)
         if cohort.host:
             for i, aid in enumerate(np.asarray(ids).reshape(-1)):
                 st = self._host_state.setdefault(int(aid), {})
@@ -378,10 +381,60 @@ class Runtime:
         self.state = self._replace(type_state=new_ts)
         self._freelist_key = fkey   # column writes don't affect freedom
 
+    # ---- sendability checks (capability-lite; ≙ type/safeto.c +
+    # expr/call.c: a send must name a behaviour the receiver's type has,
+    # and Ref[T]-typed slots may only hold ids of T's cohort). Device-side
+    # wiring is verified at trace time (engine._make_branch /
+    # api.Context.send); these are the host-boundary twins. Out-of-range
+    # ids stay permissive — they dead-letter on device, as documented. ----
+    def _check_send_target(self, target: int, bdef: BehaviourDef) -> None:
+        if 0 <= target < self.program.total:
+            owner = self.program.cohort_of(int(target)).atype.__name__
+            want = bdef.actor_type.__name__
+            if owner != want:
+                raise TypeError(
+                    f"sendability: actor {target} is a {owner}; it cannot "
+                    f"receive {want}.{bdef.name}")
+
+    def _check_ids_in_cohort(self, v, want: str, what: str) -> None:
+        """Vectorised membership: every in-world id in `v` must fall in
+        cohort `want`'s rows. Cohorts are contiguous per-shard local-row
+        ranges (shard-major slots), so this is two compares on id % nl —
+        array speed even for benchmark-scale wiring."""
+        v = np.asarray(v, np.int64).reshape(-1)
+        nl = self.program.n_local
+        c = self.program.by_type_name(want)
+        lid = v % max(nl, 1)
+        bad = ((v >= 0) & (v < self.program.total)
+               & ((lid < c.local_start) | (lid >= c.local_stop)))
+        if bad.any():
+            x = int(v[bad][0])
+            owner = self.program.cohort_of(x).atype.__name__
+            raise TypeError(
+                f"sendability: {what} expects Ref[{want}] but id {x} "
+                f"is a {owner}")
+
+    def _check_ref_args(self, specs, args, what: str) -> None:
+        for spec, v in zip(specs, args):
+            want = pack.ref_target(spec)
+            if want is not None:
+                self._check_ids_in_cohort(v, want, what)
+
+    def _check_ref_fields(self, atype: ActorTypeMeta, fields) -> None:
+        for fname, v in fields.items():
+            want = pack.ref_target(atype.field_specs.get(fname))
+            if want is not None:
+                self._check_ids_in_cohort(
+                    v, want, f"field {atype.__name__}.{fname}")
+
     # ---- external sends (≙ pony_sendv from outside the runtime) ----
     def send(self, target: int, behaviour_def: BehaviourDef, *args):
         if behaviour_def.global_id is None:
             raise RuntimeError(f"{behaviour_def} not part of this program")
+        self._check_send_target(int(target), behaviour_def)
+        self._check_ref_args(behaviour_def.arg_specs, args,
+                             f"{behaviour_def.actor_type.__name__}."
+                             f"{behaviour_def.name}")
         words = np.zeros((1 + self.opts.msg_words,), np.int32)
         words[0] = behaviour_def.global_id
         words[1:] = _host_pack_args(behaviour_def.arg_specs, args,
@@ -398,6 +451,13 @@ class Runtime:
         if len(np.unique(targets)) != len(targets):
             raise ValueError("bulk_send targets must be distinct; use "
                              "send() for repeated targets")
+        self._check_ids_in_cohort(
+            targets, behaviour_def.actor_type.__name__,
+            f"bulk_send target of {behaviour_def.actor_type.__name__}."
+            f"{behaviour_def.name}")
+        self._check_ref_args(behaviour_def.arg_specs, arg_cols,
+                             f"{behaviour_def.actor_type.__name__}."
+                             f"{behaviour_def.name}")
         k = len(targets)
         words = np.zeros((k, 1 + self.opts.msg_words), np.int32)
         words[:, 0] = behaviour_def.global_id

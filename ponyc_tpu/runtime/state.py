@@ -168,12 +168,12 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     for cohort in program.cohorts:
         fields = {}
         for fname, spec in cohort.atype.field_specs.items():
-            from ..ops.pack import F32, Ref
+            from ..ops.pack import F32, is_ref
             dtype = jnp.float32 if spec is F32 else jnp.int32
             # Ref fields default to -1 ("no actor") — id 0 is a real
             # actor, and the GC tracer treats >= 0 as an edge.
             fields[fname] = jnp.full((cohort.capacity,),
-                                     -1 if spec is Ref else 0, dtype)
+                                     -1 if is_ref(spec) else 0, dtype)
         type_state[cohort.atype.__name__] = fields
 
     return RtState(
