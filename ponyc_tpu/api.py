@@ -41,7 +41,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import Bool, F32, I32, Ref  # re-exported
+from .ops.pack import (Bool, F32, I32, Ref, VecF32,  # noqa
+                       VecI32)  # re-exported
 
 
 class BehaviourDef:

@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import F32, I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from .. import F32, I32, Ref, Runtime, RuntimeOptions, VecF32, actor, \
+    behaviour
 
 G = 6.674e-3          # scaled constant (unit system is arbitrary here)
 SOFTEN = 1e-2
@@ -22,7 +23,7 @@ SOFTEN = 1e-2
 
 @actor
 class Body:
-    next_ref: Ref
+    next_ref: Ref[Body]
     x: F32
     y: F32
     m: F32
@@ -34,14 +35,17 @@ class Body:
     BATCH = 4
 
     @behaviour
-    def token(self, st, hops: I32, px: F32, py: F32, pm: F32):
-        # Accumulate the visitor's pull on me (compute-heavy part).
-        dx = px - st["x"]
-        dy = py - st["y"]
+    def token(self, st, hops: I32, pos: VecF32[2], pm: F32):
+        # The visitor's position travels as ONE device-side float vector
+        # (pack._VecSpec: k words inside the message — ≙ pony_alloc_msg
+        # rich payloads, pony.h:332-360). pos is a [2, lanes] planar
+        # block; component reads index axis 0.
+        dx = pos[0] - st["x"]
+        dy = pos[1] - st["y"]
         r2 = dx * dx + dy * dy + SOFTEN
         inv_r = 1.0 / (r2 ** 0.5)
         f = G * pm * inv_r * inv_r * inv_r
-        self.send(st["next_ref"], Body.token, hops - 1, px, py, pm,
+        self.send(st["next_ref"], Body.token, hops - 1, pos, pm,
                   when=hops > 1)
         return {**st,
                 "ax": st["ax"] + f * dx,
@@ -75,7 +79,8 @@ def run_round(n_bodies: int = 256,
     nxt = np.roll(ids, -1)
     rt.bulk_send(nxt, Body.token,
                  np.full(n_bodies, n_bodies - 1),
-                 st["x"], st["y"], st["m"])
+                 np.stack([st["x"], st["y"]], axis=1),   # [count, 2] vec col
+                 st["m"])
     rt.run(max_steps=4 * n_bodies + 100)
     return rt
 

@@ -65,6 +65,53 @@ class Ref:
         return _RefTo(item)
 
 
+class _VecSpec:
+    """A fixed-width vector argument: VecF32[k] / VecI32[k].
+
+    ≙ the reference's rich message payloads (pony_alloc_msg + per-type
+    serialise trace, pony.h:332-360): a Pony message carries arbitrary
+    object payloads; here small arrays ride INSIDE the fixed message
+    words (k consecutive int32 lanes, bitcast for floats) — the
+    TPU-idiomatic equivalent, since mailboxes are one dense static-shape
+    table. Behaviours receive the argument as a [k, ...lanes] planar
+    block (actor lanes minor — reduce over axis 0 for per-actor dots/
+    norms)."""
+
+    __slots__ = ("base", "n")
+
+    def __init__(self, base, n: int):
+        self.base = base
+        self.n = int(n)
+        if self.n < 1:
+            raise TypeError("vector width must be >= 1")
+
+    @property
+    def __name__(self) -> str:
+        return f"Vec{self.base.__name__}[{self.n}]"
+
+    def __repr__(self):
+        return self.__name__
+
+
+class VecF32:
+    """Annotation: [k] float32 vector payload — VecF32[k]."""
+
+    def __class_getitem__(cls, n):
+        return _VecSpec(F32, n)
+
+
+class VecI32:
+    """Annotation: [k] int32 vector payload — VecI32[k]."""
+
+    def __class_getitem__(cls, n):
+        return _VecSpec(I32, n)
+
+
+def spec_width(ann) -> int:
+    """Payload words an argument occupies."""
+    return ann.n if isinstance(ann, _VecSpec) else 1
+
+
 def is_ref(ann) -> bool:
     return ann is Ref or isinstance(ann, _RefTo)
 
@@ -105,11 +152,22 @@ _MARKERS = (I32, F32, Bool, Ref)
 
 
 def normalize_annotation(ann):
-    """Map a user annotation to a marker class (or typed-ref instance)."""
-    if isinstance(ann, _RefTo):
+    """Map a user annotation to a marker class (or typed-ref / vector
+    instance)."""
+    if isinstance(ann, (_RefTo, _VecSpec)):
         return ann
     if ann in _MARKERS:
         return ann
+    if isinstance(ann, str) and ann.endswith("]"):
+        for prefix, base in (("VecF32[", F32), ("VecI32[", I32)):
+            if ann.startswith(prefix):
+                try:
+                    n = int(ann[len(prefix):-1])
+                except ValueError:
+                    break    # symbolic width → the TypeError below, which
+                    #          names the annotation (string annotations
+                    #          can't resolve module constants)
+                return _VecSpec(base, n)
     if ann in (int, jnp.int32, "int", "I32", "i32"):
         return I32
     if ann in (float, jnp.float32, "float", "F32", "f32"):
@@ -146,21 +204,49 @@ def pack_args(specs, values, msg_words):
     """Pack positional args into a [msg_words] (or planar [msg_words, R])
     int32 array, zero padded. Args may mix trace-time constants (scalars)
     with [R]-lane vectors — the planar engine evaluates behaviours on all
-    R actors of a cohort at once — so words broadcast to a common shape
-    before stacking on the (small, major) word axis."""
+    R actors of a cohort at once — and VecF32/VecI32 args contribute
+    their k words as a block; everything broadcasts to a common lane
+    shape before concatenating on the (small, major) word axis."""
     if len(values) != len(specs):
         raise TypeError(f"behaviour takes {len(specs)} args, got {len(values)}")
-    if len(specs) > msg_words:
+    total = sum(spec_width(a) for a in specs)
+    if total > msg_words:
         raise TypeError(
-            f"behaviour needs {len(specs)} payload words but msg_words="
+            f"behaviour needs {total} payload words but msg_words="
             f"{msg_words}; raise RuntimeOptions.msg_words")
-    words = [pack_arg(a, v) for a, v in zip(specs, values)]
-    words += [jnp.int32(0)] * (msg_words - len(words))
-    if len(words) > 1:
-        words = jnp.broadcast_arrays(*words)
-    return jnp.stack(words)
+    parts = []
+    for a, v in zip(specs, values):
+        if isinstance(a, _VecSpec):
+            dt = jnp.float32 if a.base is F32 else jnp.int32
+            arr = jnp.asarray(v, dt)
+            if arr.ndim == 0 or arr.shape[0] != a.n:
+                raise TypeError(
+                    f"argument for {a.__name__} must have leading dim "
+                    f"{a.n}, got shape {arr.shape}")
+            parts.append(arr.view(jnp.int32) if a.base is F32
+                         else arr.astype(jnp.int32))
+        else:
+            w = pack_arg(a, v)
+            parts.append(w.reshape((1,) + w.shape))
+    lanes = jnp.broadcast_shapes(*(p.shape[1:] for p in parts)) \
+        if parts else ()
+    parts = [jnp.broadcast_to(p, p.shape[:1] + lanes) for p in parts]
+    if total < msg_words:
+        parts.append(jnp.zeros((msg_words - total,) + lanes, jnp.int32))
+    return jnp.concatenate(parts, axis=0)
 
 
 def unpack_args(specs, words):
-    """Inverse of pack_args; returns a tuple of typed scalars."""
-    return tuple(unpack_arg(a, words[i]) for i, a in enumerate(specs))
+    """Inverse of pack_args; scalars per spec, [k, ...lanes] blocks for
+    vector specs."""
+    out = []
+    off = 0
+    for a in specs:
+        if isinstance(a, _VecSpec):
+            blk = words[off:off + a.n]
+            out.append(blk.view(jnp.float32) if a.base is F32 else blk)
+            off += a.n
+        else:
+            out.append(unpack_arg(a, words[off]))
+            off += 1
+    return tuple(out)

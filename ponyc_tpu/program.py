@@ -151,16 +151,32 @@ class Program:
         # type/safeto.c's sendability): every typed Ref[T] field or
         # behaviour argument must name a type declared in this program —
         # a miswired program fails HERE, at build, not as runtime badmsg.
-        from .ops.pack import ref_target
+        # Payload geometry is verified too: a behaviour's total argument
+        # width (vector args count their k words) must fit msg_words, and
+        # vector specs are message-payload-only (state columns are
+        # scalar by design — use one field per component).
+        from .ops.pack import _VecSpec, ref_target, spec_width
         declared = {c.atype.__name__ for c in self.cohorts}
         for cohort in self.cohorts:
             for fname, spec in cohort.atype.field_specs.items():
+                if isinstance(spec, _VecSpec):
+                    raise TypeError(
+                        f"{cohort.atype.__name__}.{fname}: {spec.__name__} "
+                        "is a message-payload annotation; state fields are "
+                        "scalar columns — declare one field per component")
                 t = ref_target(spec)
                 if t is not None and t not in declared:
                     raise TypeError(
                         f"{cohort.atype.__name__}.{fname} is Ref[{t}] but "
                         f"{t} is not declared in this program")
             for b in cohort.behaviours:
+                total = sum(spec_width(s) for s in b.arg_specs)
+                if total > self.opts.msg_words:
+                    raise TypeError(
+                        f"{cohort.atype.__name__}.{b.name} needs {total} "
+                        f"payload words but msg_words="
+                        f"{self.opts.msg_words}; raise "
+                        "RuntimeOptions.msg_words")
                 for i, spec in enumerate(b.arg_specs):
                     t = ref_target(spec)
                     if t is not None and t not in declared:

@@ -56,13 +56,15 @@ def build_ref_arg_mask(program: Program, msg_words: int) -> np.ndarray:
     """Static [n_gids, msg_words] bool: which payload words of each
     behaviour message are actor refs (≙ the per-type trace function the
     compiler emits, gentrace.c — here derived from Ref annotations)."""
-    from ..ops.pack import is_ref
+    from ..ops.pack import is_ref, spec_width
     n = len(program.behaviour_table)
     mask = np.zeros((max(n, 1), msg_words), bool)
     for gid, bdef in enumerate(program.behaviour_table):
-        for i, spec in enumerate(bdef.arg_specs):
-            if is_ref(spec) and i < msg_words:
-                mask[gid, i] = True
+        off = 0
+        for spec in bdef.arg_specs:
+            if is_ref(spec) and off < msg_words:
+                mask[gid, off] = True
+            off += spec_width(spec)
     return mask
 
 

@@ -78,20 +78,40 @@ def _host_pack_args(specs, args, msg_words):
     words = np.zeros((msg_words,), np.int32)
     if len(args) != len(specs):
         raise TypeError(f"behaviour takes {len(specs)} args, got {len(args)}")
-    for i, (spec, v) in enumerate(zip(specs, args)):
-        if spec is pack.F32:
-            words[i] = np.float32(v).view(np.int32)
+    off = 0
+    for spec, v in zip(specs, args):
+        if isinstance(spec, pack._VecSpec):
+            dt = np.float32 if spec.base is pack.F32 else np.int32
+            arr = np.asarray(v, dt).reshape(-1)
+            if arr.shape[0] != spec.n:
+                raise TypeError(f"argument for {spec.__name__} must have "
+                                f"{spec.n} elements, got {arr.shape[0]}")
+            words[off:off + spec.n] = arr.view(np.int32)
+            off += spec.n
+        elif spec is pack.F32:
+            words[off] = np.float32(v).view(np.int32)
+            off += 1
         elif spec is pack.Bool:
-            words[i] = np.int32(bool(v))
+            words[off] = np.int32(bool(v))
+            off += 1
         else:
-            words[i] = np.int32(v)
+            words[off] = np.int32(v)
+            off += 1
     return words
 
 
 def _host_unpack_args(specs, words):
     out = []
-    for i, spec in enumerate(specs):
-        w = np.int32(words[i])
+    off = 0
+    for spec in specs:
+        if isinstance(spec, pack._VecSpec):
+            blk = np.asarray(words[off:off + spec.n], np.int32)
+            out.append(blk.view(np.float32) if spec.base is pack.F32
+                       else blk)
+            off += spec.n
+            continue
+        w = np.int32(words[off])
+        off += 1
         if spec is pack.F32:
             out.append(float(w.view(np.float32)))
         elif spec is pack.Bool:
@@ -465,12 +485,27 @@ class Runtime:
         if len(arg_cols) != len(specs):
             raise TypeError(
                 f"behaviour takes {len(specs)} args, got {len(arg_cols)}")
-        for i, (spec, col) in enumerate(zip(specs, arg_cols)):
+        off = 1
+        for spec, col in zip(specs, arg_cols):
             col = np.asarray(col)
-            if spec is pack.F32:
-                words[:, 1 + i] = col.astype(np.float32).view(np.int32)
+            if isinstance(spec, pack._VecSpec):
+                # One [count, n] column block per vector argument; the
+                # layout is validated, not reinterpreted — a transposed
+                # block would silently interleave components otherwise.
+                if col.shape != (k, spec.n):
+                    raise TypeError(
+                        f"bulk_send column for {spec.__name__} must have "
+                        f"shape ({k}, {spec.n}), got {col.shape}")
+                dt = np.float32 if spec.base is pack.F32 else np.int32
+                blk = np.ascontiguousarray(col.astype(dt))
+                words[:, off:off + spec.n] = blk.view(np.int32)
+                off += spec.n
+            elif spec is pack.F32:
+                words[:, off] = col.astype(np.float32).view(np.int32)
+                off += 1
             else:
-                words[:, 1 + i] = col.astype(np.int32)
+                words[:, off] = col.astype(np.int32)
+                off += 1
         tail = self.state.tail
         t_at = np.asarray(tail[targets])
         occ = t_at - np.asarray(self.state.head[targets])

@@ -1,0 +1,115 @@
+"""Device-side vector message payloads (VecF32[k] / VecI32[k]).
+
+≙ the reference's rich message payloads: pony_alloc_msg carries
+arbitrary object graphs (pony.h:332-360, gc/serialise.c); here small
+arrays ride inside the fixed message words — k consecutive int32 lanes,
+float bitcast — which is the static-shape TPU equivalent (state.py's
+dense mailbox table stays one array).
+"""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (F32, I32, Ref, Runtime, RuntimeOptions, VecF32,
+                       VecI32, actor, behaviour)
+from ponyc_tpu.models import nbody
+
+
+def test_vecf32_roundtrip_device():
+    @actor
+    class Accum:
+        s0: F32
+        s1: F32
+        s2: F32
+        n: I32
+
+        @behaviour
+        def add(self, st, v: VecF32[3], scale: F32):
+            return {**st,
+                    "s0": st["s0"] + v[0] * scale,
+                    "s1": st["s1"] + v[1] * scale,
+                    "s2": st["s2"] + v[2] * scale,
+                    "n": st["n"] + 1}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, max_sends=1,
+                                msg_words=4, inject_slots=16))
+    rt.declare(Accum, 1).start()
+    a = rt.spawn(Accum)
+    rt.send(a, Accum.add, [1.5, -2.25, 0.125], 2.0)
+    rt.send(a, Accum.add, np.asarray([0.5, 0.5, 0.5]), 1.0)
+    assert rt.run() == 0
+    st = rt.state_of(a)
+    assert st["n"] == 2
+    assert st["s0"] == pytest.approx(1.5 * 2 + 0.5)
+    assert st["s1"] == pytest.approx(-2.25 * 2 + 0.5)
+    assert st["s2"] == pytest.approx(0.125 * 2 + 0.5)
+
+
+def test_veci32_and_forwarding():
+    @actor
+    class Hop:
+        out: Ref
+        a: I32
+        b: I32
+        MAX_SENDS = 1
+
+        @behaviour
+        def fwd(self, st, v: VecI32[2], hops: I32):
+            # Forward the same vector block onward (payload pass-through).
+            self.send(st["out"], Hop.fwd, v, hops - 1, when=hops > 0)
+            return {**st, "a": v[0], "b": v[1]}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Hop, 3).start()
+    ids = rt.spawn_many(Hop, 3)
+    rt.set_fields(Hop, ids, out=np.roll(ids, -1))
+    rt.send(int(ids[0]), Hop.fwd, [7, -9], 2)
+    assert rt.run(max_steps=16) == 0
+    for i in range(3):
+        st = rt.state_of(int(ids[i]))
+        assert (st["a"], st["b"]) == (7, -9)
+
+
+def test_vec_width_overflow_raises():
+    with pytest.raises(TypeError, match="payload words"):
+        @actor
+        class Big:
+            x: I32
+
+            @behaviour
+            def b(self, st, v: VecF32[9]):
+                return st
+
+        rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                    msg_words=4, inject_slots=8))
+        rt.declare(Big, 1).start()
+        a = rt.spawn(Big)
+        rt.send(a, Big.b, [0.0] * 9)
+
+
+def test_vec_wrong_length_raises():
+    @actor
+    class T:
+        x: I32
+
+        @behaviour
+        def b(self, st, v: VecF32[3]):
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=4, inject_slots=8))
+    rt.declare(T, 1).start()
+    a = rt.spawn(T)
+    with pytest.raises(TypeError, match="elements"):
+        rt.send(a, T.b, [1.0, 2.0])
+
+
+def test_nbody_float_vectors_device_side():
+    n = 64
+    rt = nbody.run_round(n)
+    st = rt.cohort_state(nbody.Body)
+    assert (st["seen"] == n - 1).all()     # every body saw every other
+    ax, ay = nbody.reference_accels(st["x"], st["y"], st["m"])
+    np.testing.assert_allclose(st["ax"], ax, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(st["ay"], ay, rtol=2e-4, atol=2e-5)
