@@ -85,6 +85,11 @@ class RuntimeOptions:
 
     # --- sharding (≙ the scale axis the reference lacks; SURVEY §2.4) ---
     mesh_shards: int = 1           # actor-axis shards (1 = single chip)
+    route_bucket: int = 0          # per-destination all_to_all bucket
+    #   entries. 0 = auto-size (state.layout_sizes): covers the worst
+    #   case one-shard emission up to 4 shards; beyond that (or with an
+    #   explicit smaller value) a saturated link parks messages in the
+    #   route spill and mutes senders — backpressure, not loss
 
     def __post_init__(self):
         if self.mailbox_cap & (self.mailbox_cap - 1):

@@ -230,7 +230,13 @@ def pack_args(specs, values, msg_words):
             parts.append(w.reshape((1,) + w.shape))
     lanes = jnp.broadcast_shapes(*(p.shape[1:] for p in parts)) \
         if parts else ()
-    parts = [jnp.broadcast_to(p, p.shape[:1] + lanes) for p in parts]
+    # Align trailing (lane) axes before broadcasting, so a trace-time
+    # constant vector (shape [k]) can ride next to lane-varying args
+    # (shape [k', R]): [k] → [k, 1, ...] → [k, R, ...].
+    parts = [jnp.broadcast_to(
+        p.reshape(p.shape[:1] + (1,) * (len(lanes) - (p.ndim - 1))
+                  + p.shape[1:]),
+        p.shape[:1] + lanes) for p in parts]
     if total < msg_words:
         parts.append(jnp.zeros((msg_words - total,) + lanes, jnp.int32))
     return jnp.concatenate(parts, axis=0)

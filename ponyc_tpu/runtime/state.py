@@ -66,9 +66,13 @@ def layout_sizes(program: Program, opts: RuntimeOptions):
     s = opts.spill_cap
     p = program.shards
     if p > 1:
-        # Worst case one shard receives everything; keep buckets at
-        # outbox-size/shards ×4 (tunable; overflow is safe).
-        bucket = max(16, min(e_out + s, 4 * (e_out + s) // p))
+        if opts.route_bucket > 0:
+            bucket = opts.route_bucket
+        else:
+            # Worst case one shard receives everything; keep buckets at
+            # outbox-size/shards ×4 (overflow is safe — it parks in the
+            # route spill; opts.route_bucket overrides).
+            bucket = max(16, min(e_out + s, 4 * (e_out + s) // p))
         incoming = p * bucket
     else:
         bucket = 0

@@ -113,3 +113,30 @@ def test_nbody_float_vectors_device_side():
     ax, ay = nbody.reference_accels(st["x"], st["y"], st["m"])
     np.testing.assert_allclose(st["ax"], ax, rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(st["ay"], ay, rtol=2e-4, atol=2e-5)
+
+
+def test_constant_vec_beside_lane_varying_arg():
+    # A trace-time-constant vector literal must broadcast next to a
+    # lane-varying scalar (regression: pack_args trailing-axis alignment).
+    import jax.numpy as jnp
+
+    @actor
+    class T:
+        out: Ref
+        n: I32
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st, v: VecF32[2], n: I32):
+            self.send(st["out"], T.go, jnp.asarray([1.0, 2.0]),
+                      n - 1, when=n > 1)
+            return {**st, "n": n}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=3, inject_slots=8))
+    rt.declare(T, 2).start()
+    a, b = rt.spawn_many(T, 2)
+    rt.set_fields(T, [a, b], out=np.asarray([b, a]))
+    rt.send(int(a), T.go, [0.0, 0.0], 2)
+    assert rt.run(max_steps=8) == 0
+    assert rt.state_of(int(b))["n"] == 1      # got the forwarded hop
