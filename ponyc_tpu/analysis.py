@@ -39,6 +39,12 @@ CSV_COLUMNS = [
     "overloaded_now", "host_processed", "inject_queue",
 ]
 
+# Level-3 per-event lane (≙ analysis.h:16-31 event enum; the device
+# records transition events in a bounded ring, engine.py §5b).
+EVENT_NAMES = {1: "MUTE", 2: "UNMUTE", 3: "OVERLOAD", 4: "SPAWN",
+               5: "DESTROY", 6: "ERROR"}
+EVENT_COLUMNS = ["time_ms", "step", "event", "actor"]
+
 
 class Analysis:
     """Per-runtime telemetry collector + writer thread (level 2)."""
@@ -59,6 +65,8 @@ class Analysis:
 
     # -- window hook (called by Runtime.run after each aux fetch) --
     def window(self, aux) -> None:
+        if self.level >= 3:
+            self._drain_events()
         if self.level < 2:
             return
         # All counters ride the StepAux the run loop already fetched —
@@ -85,17 +93,55 @@ class Analysis:
         self._prev[key] = cur
         return int(cur - prev)
 
+    def _drain_events(self) -> None:
+        """Pull the device event ring (engine §5b) and reset it. Rows go
+        through the same writer thread, tagged for the events CSV."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        rt = self.rt
+        st = rt.state
+        counts = np.asarray(st.ev_count)
+        if counts.sum() == 0:
+            return
+        data = np.asarray(st.ev_data)            # [3, P*EV]
+        ev_cap = rt.opts.analysis_events
+        now = round((time.time() - self.t0) * 1e3, 3)
+        for shard, cnt in enumerate(counts):
+            seg = data[:, shard * ev_cap: shard * ev_cap + int(cnt)]
+            for i in range(seg.shape[1]):
+                self._rows.put(("ev", [
+                    now, int(seg[2, i]),
+                    EVENT_NAMES.get(int(seg[0, i]), "?"),
+                    int(seg[1, i])]))
+        fkey = rt._freelist_key
+        rt.state = _dc.replace(st, ev_count=jnp.zeros_like(st.ev_count))
+        rt._freelist_key = fkey       # count reset frees no slots
+
     def _write_loop(self) -> None:
-        path = self.rt.opts.analysis_path
-        with open(path, "w") as f:
-            f.write(",".join(CSV_COLUMNS) + "\n")
-            while not (self._stop.is_set() and self._rows.empty()):
-                try:
-                    row = self._rows.get(timeout=0.1)
-                except queue.Empty:
-                    continue
-                f.write(",".join(str(x) for x in row) + "\n")
-                f.flush()
+        opts = self.rt.opts
+        ev_f = open(opts.analysis_path + ".events.csv", "w") \
+            if self.level >= 3 else None
+        try:
+            if ev_f is not None:
+                ev_f.write(",".join(EVENT_COLUMNS) + "\n")
+            with open(opts.analysis_path, "w") as f:
+                f.write(",".join(CSV_COLUMNS) + "\n")
+                while not (self._stop.is_set() and self._rows.empty()):
+                    try:
+                        row = self._rows.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    if isinstance(row, tuple) and row[0] == "ev":
+                        ev_f.write(",".join(str(x) for x in row[1]) + "\n")
+                        ev_f.flush()
+                    else:
+                        f.write(",".join(str(x) for x in row) + "\n")
+                        f.flush()
+        finally:
+            if ev_f is not None:
+                ev_f.close()
 
     # -- live-world dump (level >= 1; SIGTERM/SIGUSR1 and run() end) --
     def dump(self, out=None) -> str:
@@ -108,6 +154,10 @@ class Analysis:
             lines.append(f"{name}={rt.counter(name)}")
         lines.append(f"host_processed={rt.totals.get('host_processed', 0)} "
                      f"inject_queue={len(rt._inject_q)}")
+        if self.level >= 3 and rt.state is not None:
+            lines.append(
+                f"events_pending={int(np.asarray(rt.state.ev_count).sum())} "
+                f"events_dropped={int(np.asarray(rt.state.ev_dropped).sum())}")
         # Memory accounting (≙ USE_MEMTRACK counters, scheduler.h:52-66):
         # native pool blocks + host-heap handles.
         try:

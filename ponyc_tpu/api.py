@@ -163,9 +163,11 @@ class Context:
     __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
                  "spawn_claims", "destroy_called", "error_flag",
-                 "error_code", "error_called", "ref_types")
+                 "error_code", "error_called", "ref_types", "_spawn_meta",
+                 "sync_inits", "_effected")
 
-    def __init__(self, actor_id, msg_words: int, spawn_resv=None):
+    def __init__(self, actor_id, msg_words: int, spawn_resv=None,
+                 spawn_meta=None):
         self.actor_id = actor_id          # traced i32 scalar (global id)
         self.msg_words = msg_words
         self.sends: List[Tuple[Any, Any, Any]] = []   # (target, words, when)
@@ -187,6 +189,11 @@ class Context:
         # Trace-time typed-ref provenance; the engine tags the typed
         # state fields and typed args into it before dispatch.
         self.ref_types = pack.RefTypes()
+        # {target type name: field_specs} for sync construction.
+        self._spawn_meta = spawn_meta or {}
+        # {target type name: {site index: (state dict, ok mask)}}.
+        self.sync_inits: Dict[str, Dict[int, Any]] = {}
+        self._effected = False    # trace-time: any exit()/yield_() call
 
     # -- messaging (≙ pony_sendv, actor.c:773-834) --
     def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
@@ -240,8 +247,19 @@ class Context:
         the sticky `spawn_fail` flag raises host-side, and the masked
         constructor send drops harmlessly.
         """
+        tname, ref, ok = self._claim_slot(ctor, when, "spawn")
+        self.send(ref, ctor, *args, when=ok)
+        # The returned ref is typed (provenance-tagged): storing it in a
+        # mistyped Ref[T] field or sending it a foreign behaviour fails
+        # at build.
+        return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
+
+    def _claim_slot(self, ctor, when, what: str):
+        """Shared spawn preamble: budget checks + slot claim bookkeeping
+        (≙ pony_create's allocation, actor.c:688-734). Returns
+        (target type name, reserved ref, ok mask)."""
         if not isinstance(ctor, BehaviourDef):
-            raise TypeError("spawn() takes a constructor behaviour "
+            raise TypeError(f"{what}() takes a constructor behaviour "
                             "(e.g. Worker.init)")
         tname = ctor.actor_type.__name__
         resv = self._spawn_resv.get(tname)
@@ -252,17 +270,67 @@ class Context:
         used = len(self.spawn_claims[tname])
         if used >= resv.shape[0]:
             raise RuntimeError(
-                f"more than SPAWNS[{tname}]={resv.shape[0]} ctx.spawn() "
-                "calls in one behaviour dispatch; raise the declared budget")
+                f"more than SPAWNS[{tname}]={resv.shape[0]} spawns in one "
+                "behaviour dispatch; raise the declared budget")
         ref = resv[used]
         w = jnp.asarray(when, jnp.bool_)
         ok = w & (ref >= 0)
         self.spawn_claims[tname].append(jnp.where(ok, ref, jnp.int32(-1)))
         self.spawn_fail = self.spawn_fail | (w & (ref < 0))
-        self.send(ref, ctor, *args, when=ok)
-        # The returned ref is typed (provenance-tagged): storing it in a
-        # mistyped Ref[T] field or sending it a foreign behaviour fails
-        # at build.
+        return tname, ref, ok
+
+    def spawn_sync(self, ctor: BehaviourDef, *args, when=True):
+        """Spawn with a SYNCHRONOUS constructor (≙ the fork's
+        pony_sendv_synchronous_constructor, actor.c:836-848): the
+        constructor behaviour runs *inside this dispatch* on the
+        newborn's zeroed state, and the resulting fields are written when
+        the slot is claimed — so same-step sends to the new ref find a
+        fully constructed actor next tick, with no ordering convention.
+
+        The constructor must be PURE construction: returning the initial
+        state only. Effects inside it (send/spawn/exit/destroy/yield/
+        error) raise at build — an effectful create needs the async
+        `spawn`, whose constructor message is a real dispatch.
+        """
+        tname, ref, ok = self._claim_slot(ctor, when, "spawn_sync")
+        specs = self._spawn_meta.get(tname)
+        if specs is None:
+            raise RuntimeError(
+                "spawn_sync is only available in device behaviours")
+        used = len(self.spawn_claims[tname]) - 1   # site just claimed
+        # Constructor arguments obey the same sendability rule as a send
+        # (≙ expr/call.c parameter checks): a typed ref arg must match.
+        for spec, a in zip(ctor.arg_specs, args):
+            want = pack.ref_target(spec)
+            got = self.ref_types.lookup(a)
+            if want is not None and got is not None and got != want:
+                raise TypeError(
+                    f"sendability: {tname}.{ctor.name} expects Ref[{want}] "
+                    f"but was passed a Ref[{got}]")
+        # Run the constructor NOW on zeroed defaults (≙ the synchronous
+        # field assignment), in a throwaway context that must stay inert.
+        cctx = Context(ref, self.msg_words)
+        zero = {f: (jnp.int32(-1) if pack.is_ref(s) else
+                    jnp.float32(0) if s is pack.F32 else jnp.int32(0))
+                for f, s in specs.items()}
+        st2 = ctor.fn(cctx, zero, *args)
+        if st2 is None or set(st2.keys()) != set(specs.keys()):
+            raise TypeError(
+                f"sync constructor {ctor} must return the full state dict "
+                f"({sorted(specs)})")
+        if (cctx.sends or cctx.destroy_called or cctx.error_called
+                or any(cctx.spawn_claims.values()) or cctx._effected):
+            raise TypeError(
+                f"sync constructor {ctor} performs effects; effects need a "
+                "real dispatch — use ctx.spawn (async constructor message)")
+        for f, s in specs.items():
+            want = pack.ref_target(s)
+            got = self.ref_types.lookup(st2[f])
+            if want is not None and got is not None and got != want:
+                raise TypeError(
+                    f"sendability: sync constructor {ctor} stores a "
+                    f"Ref[{got}] into field {f!r} declared Ref[{want}]")
+        self.sync_inits.setdefault(tname, {})[used] = (st2, ok)
         return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
 
     def destroy(self, when=True):
@@ -280,6 +348,7 @@ class Context:
 
     def exit(self, code=0, when=True):
         """Request program termination (≙ pony_exitcode + quiescent stop)."""
+        self._effected = True
         w = jnp.asarray(when, jnp.bool_)
         self.exit_flag = self.exit_flag | w
         self.exit_code = jnp.where(w, jnp.asarray(code, jnp.int32),
@@ -288,6 +357,7 @@ class Context:
     def yield_(self, when=True):
         """Stop draining this actor's mailbox for the rest of the step
         (≙ the fork's ponyint_actor_yield, actor.c:675-679)."""
+        self._effected = True
         self.yield_flag = self.yield_flag | jnp.asarray(when, jnp.bool_)
 
     def error_int(self, code, when=True):

@@ -77,8 +77,15 @@ class RuntimeOptions:
     host_out_slots: int = 256      # device→host delivered msgs per step
 
     # --- analysis / telemetry (≙ --ponyanalysis, analysis.c) ---
-    analysis: int = 0              # 0 off, 1 summary, 2 full event CSV
+    analysis: int = 0              # 0 off, 1 summary, 2 window CSV,
+    #   3 = 2 + per-EVENT rows (mute/unmute/overload/spawn/destroy/error
+    #   transitions recorded on device in a bounded ring, drained to
+    #   <analysis_path>.events.csv at window boundaries — ≙ the fork's
+    #   per-event rows, analysis.c:587-692; costs one compaction per
+    #   busy tick while enabled)
     analysis_path: str = "/tmp/pony_tpu.analytics.csv"
+    analysis_events: int = 4096    # device event-ring entries per shard
+    #   (level 3); overflow between two drains drops and counts
     debug_checks: bool = False     # run Runtime.check_invariants() at
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)

@@ -99,7 +99,8 @@ def _bcast_lanes(v, dtype, lanes: int):
 
 
 def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
-                 field_specs, spawn_sites, effects, lanes: int):
+                 field_specs, spawn_sites, spawn_meta, effects,
+                 lanes: int):
     """Wrap one behaviour as a *planar* evaluator: it runs on ALL `lanes`
     actors of the cohort at once (state fields, args, and effect masks
     are [lanes] vectors) and the dispatcher selects its outputs where the
@@ -115,7 +116,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
     w1 = 1 + msg_words
 
     def branch(st, payload, ids_vec, resv_k):
-        ctx = Context(ids_vec, msg_words, spawn_resv=resv_k)
+        ctx = Context(ids_vec, msg_words, spawn_resv=resv_k,
+                      spawn_meta=spawn_meta)
         args = pack.unpack_args(bdef.arg_specs, payload)
         # Typed Ref[T] state fields and args enter the behaviour as PLAIN
         # arrays whose trace-time identity is tagged with the declared
@@ -129,6 +131,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
         st2 = bdef.fn(ctx, dict(st), *args)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
         effects["error"] = effects["error"] or ctx.error_called
+        effects["sync_init"] = (effects["sync_init"]
+                                or bool(ctx.sync_inits))
         if st2 is None:
             raise TypeError(
                 f"behaviour {bdef} must return the (possibly updated) state "
@@ -162,17 +166,39 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
             tgts.append(jnp.full((lanes,), -1, jnp.int32))
             words.append(jnp.zeros((w1, lanes), jnp.int32))
         claims = []
+        inits = []
         for tname, n in spawn_sites:
             got = [_bcast_lanes(g, jnp.int32, lanes)
                    for g in ctx.spawn_claims.get(tname, [])]
             got += [jnp.full((lanes,), -1, jnp.int32)] * (n - len(got))
             claims.append(got)
+            # Sync-constructor field values per site (spawn_sync): the
+            # `has` mask selects them over zero-defaults at claim time.
+            t_specs = spawn_meta[tname]
+            t_dt = {f: (jnp.float32 if s is pack.F32 else jnp.int32)
+                    for f, s in t_specs.items()}
+            site_map = ctx.sync_inits.get(tname, {})
+            has_l, vals_l = [], {f: [] for f in t_specs}
+            for s_i in range(n):
+                ent = site_map.get(s_i)
+                if ent is None:
+                    has_l.append(jnp.zeros((lanes,), jnp.bool_))
+                    for f, sp in t_specs.items():
+                        d = -1 if pack.is_ref(sp) else 0
+                        vals_l[f].append(jnp.full((lanes,), d, t_dt[f]))
+                else:
+                    ist, ok = ent
+                    has_l.append(_bcast_lanes(ok, jnp.bool_, lanes))
+                    for f in t_specs:
+                        vals_l[f].append(
+                            _bcast_lanes(ist[f], t_dt[f], lanes))
+            inits.append((has_l, vals_l))
         b = jnp.bool_
         return (st2, (tgts, words),
                 (_bcast_lanes(ctx.exit_flag, b, lanes),
                  _bcast_lanes(ctx.exit_code, jnp.int32, lanes)),
                 _bcast_lanes(ctx.yield_flag, b, lanes),
-                claims,
+                claims, inits,
                 _bcast_lanes(ctx.spawn_fail, b, lanes),
                 _bcast_lanes(ctx.destroy_flag, b, lanes),
                 (_bcast_lanes(ctx.error_flag, b, lanes),
@@ -181,7 +207,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
     return branch
 
 
-def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
+def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
+                     program: Program):
     """Build the planar per-cohort drain loop.
 
     ≙ ponyint_actor_run (actor.c:383-549): pop ≤batch app messages,
@@ -201,10 +228,14 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         field_dtypes[fname] = (jnp.float32 if spec is pack.F32
                                else jnp.int32)
     spawn_sites = tuple(sorted(cohort.spawns.items()))
-    effects = {"destroy": False, "error": False}
+    # Field specs of every spawn-target type, for synchronous
+    # construction (Context.spawn_sync).
+    spawn_meta = {t: program.by_type_name(t).atype.field_specs
+                  for t, _ in spawn_sites}
+    effects = {"destroy": False, "error": False, "sync_init": False}
     branches = [_make_branch(b, msg_words, ms, field_dtypes,
                              cohort.atype.field_specs, spawn_sites,
-                             effects, rows)
+                             spawn_meta, effects, rows)
                 for b in cohort.behaviours]
     nb = len(cohort.behaviours)
     base = cohort.behaviours[0].global_id if nb else 0
@@ -248,9 +279,20 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
             erc_n = jnp.zeros((rows,), jnp.int32)
             clm_n = [[jnp.full((rows,), -1, jnp.int32)
                       for _ in range(n)] for _, n in spawn_sites]
+            ini_n = []
+            for tname, n in spawn_sites:
+                t_specs = spawn_meta[tname]
+                t_dt = {f: (jnp.float32 if sp is pack.F32 else jnp.int32)
+                        for f, sp in t_specs.items()}
+                ini_n.append((
+                    [jnp.zeros((rows,), jnp.bool_) for _ in range(n)],
+                    {f: [jnp.full((rows,),
+                                  -1 if pack.is_ref(sp) else 0, t_dt[f])
+                         for _ in range(n)]
+                     for f, sp in t_specs.items()}))
             for j, br in enumerate(branches):
                 take = (do & in_range & (local == j))
-                (st2, (btgt, bwrd), (bef, bec), byf, bclm, bsf, bds,
+                (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf, bds,
                  (berf, berc)) = br(st, msg[1:], ids, resv_k)
                 for k in st_n:
                     st_n[k] = jnp.where(take, st2[k], st_n[k])
@@ -265,9 +307,14 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                 erf_n = jnp.where(take, berf, erf_n)
                 erc_n = jnp.where(take, berc, erc_n)
                 for si, (_, n) in enumerate(spawn_sites):
+                    bh, bv = bini[si]
+                    hh, vv = ini_n[si]
                     for s in range(n):
                         clm_n[si][s] = jnp.where(take, bclm[si][s],
                                                  clm_n[si][s])
+                        hh[s] = jnp.where(take, bh[s], hh[s])
+                        for f in vv:
+                            vv[f][s] = jnp.where(take, bv[f][s], vv[f][s])
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -282,13 +329,19 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
             claims = tuple(
                 (jnp.stack(c) if c else jnp.zeros((0, rows), jnp.int32))
                 for c in clm_n)
+            inits = tuple(
+                ((jnp.stack(hh) if hh else jnp.zeros((0, rows), jnp.bool_)),
+                 {f: (jnp.stack(vs) if vs
+                      else jnp.zeros((0, rows), jnp.int32))
+                  for f, vs in vv.items()})
+                for hh, vv in ini_n)
             return ((st_n, stopped2, new_ef, new_ec, sfail | sf_n,
                      dstr | ds_n, errf | erf_n,
                      jnp.where(erf_n, erc_n, errc),
                      used + spawned_here.astype(jnp.int32),
                      nproc + (do & in_range).astype(jnp.int32),
                      nbad + (do & ~in_range).astype(jnp.int32)),
-                    (stgt, swrd, do, claims))
+                    (stgt, swrd, do, claims, inits))
 
         def busy_fn(_):
             n_run = jnp.where(runnable_rows,
@@ -304,7 +357,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                       z(jnp.int32), z(jnp.int32))
             ((stf, _, ef, ec, sfail, dstr, errf, errc, _used, nproc,
               nbad),
-             (stgt, swrd, consumed, claims)) = lax.scan(
+             (stgt, swrd, consumed, claims, inits)) = lax.scan(
                 scan_body, carry0, (msgs, valids))
             # stgt [batch, ms, rows] → flat [e] with rows minor;
             # swrd [batch, ms, w1, rows] → [w1, e] planar.
@@ -316,6 +369,9 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
             return (stf, out_tgt, out_words, head_rows + n_consumed,
                     any_exit, code, jnp.sum(nproc), jnp.sum(nbad),
                     tuple(c.reshape(-1) for c in claims),
+                    tuple((h.reshape(-1),
+                           {f: v.reshape(-1) for f, v in vals.items()})
+                          for h, vals in inits),
                     jnp.any(sfail), dstr, errf, errc)
 
         def idle_fn(_):
@@ -330,6 +386,13 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
                     jnp.int32(0), jnp.int32(0),
                     tuple(jnp.full((batch * n * rows,), -1, jnp.int32)
                           for _, n in spawn_sites),
+                    tuple((jnp.zeros((batch * n * rows,), jnp.bool_),
+                           {f: jnp.zeros(
+                               (batch * n * rows,),
+                               jnp.float32 if sp is pack.F32
+                               else jnp.int32)
+                            for f, sp in spawn_meta[tname].items()})
+                          for tname, n in spawn_sites),
                     jnp.bool_(False),
                     jnp.zeros((rows,), jnp.bool_),
                     jnp.zeros((rows,), jnp.bool_),
@@ -339,13 +402,16 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool):
         # (cond traces both branches here, so `effects` is fully
         # populated by the time the lines below read it.)
         (stf, out_tgt, out_words, new_head, any_exit, code, nproc, nbad,
-         claims_t, sfail, dstr, errf, errc) = lax.cond(
+         claims_t, inits_t, sfail, dstr, errf, errc) = lax.cond(
             busy, busy_fn, idle_fn, operand=None)
         sender = jnp.tile(ids, batch * ms)    # entry (b, m, r): sender=ids[r]
         out = Entries(tgt=out_tgt, sender=sender, words=out_words)
         flat_claims = {t: c for (t, _), c in zip(spawn_sites, claims_t)}
+        flat_inits = {t: i for (t, _), i in zip(spawn_sites, inits_t)}
         return (stf, out, new_head, any_exit, code, nproc, nbad,
-                flat_claims, sfail,
+                flat_claims,
+                flat_inits if effects["sync_init"] else None,
+                sfail,
                 dstr if effects["destroy"] else None,
                 (errf, errc) if effects["error"] else None)
 
@@ -451,7 +517,7 @@ def build_step(program: Program, opts: RuntimeOptions):
     fh = program.first_host_row
     s_cap = opts.spill_cap
     dev_cohorts = program.device_cohorts
-    dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield), ch)
+    dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield, program), ch)
                    for ch in dev_cohorts]
     e_out, bucket, _n_entries = layout_sizes(program, opts)
     # Delivery priority levels (see delivery.deliver): 0 = receiver
@@ -576,6 +642,8 @@ def build_step(program: Program, opts: RuntimeOptions):
         out_entries: List[Entries] = []
         claim_lists: Dict[str, List[jnp.ndarray]] = {
             t: [] for t in program.spawn_target_names}
+        init_lists: Dict[str, List[Any]] = {
+            t: [] for t in program.spawn_target_names}
         destroy_rows: List[Tuple[int, jnp.ndarray]] = []  # (s0, [rows] bool)
         error_rows: List[Tuple[int, Any]] = []   # (s0, ([rows] bool, codes))
         exit_f = st.exit_flag[0]
@@ -586,8 +654,8 @@ def build_step(program: Program, opts: RuntimeOptions):
         for run_cohort, ch in dispatchers:
             s0, s1 = ch.local_start, ch.local_stop
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
-            (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, sfail,
-             dstr, errs) = run_cohort(
+            (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, inits,
+             sfail, dstr, errs) = run_cohort(
                 st.type_state[ch.atype.__name__],
                 st.buf[:, :, s0:s1], st.head[s0:s1], occ0[s0:s1],
                 runnable[s0:s1], ids, cohort_resv(ch))
@@ -596,6 +664,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             out_entries.append(out)
             for t, cl in claims.items():
                 claim_lists[t].append(cl)
+                init_lists[t].append(None if inits is None else inits[t])
             if ch.spawns:
                 spawn_fail = spawn_fail | sfail
             destroy_rows.append((s0, dstr))
@@ -621,6 +690,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             if not clist:
                 continue
             refs = jnp.concatenate(clist)
+            any_sync = any(e is not None for e in init_lists[tname])
             rows = jnp.where(refs >= 0, refs - base, nl)  # row nl → dropped
             alive = alive.at[rows].set(True, mode="drop")
             new_head = new_head.at[rows].set(0, mode="drop")
@@ -629,11 +699,31 @@ def build_step(program: Program, opts: RuntimeOptions):
             tc = program.by_type_name(tname)
             cols = jnp.where(refs >= 0, rows - tc.local_start,
                              tc.local_capacity)
+            if any_sync:
+                # Cohorts that never spawn_sync contribute constant-False
+                # has-masks (the lanes cost only exists when some
+                # behaviour of the program actually sync-constructs).
+                has_init = jnp.concatenate(
+                    [e[0] if e is not None
+                     else jnp.zeros((cl.shape[0],), jnp.bool_)
+                     for e, cl in zip(init_lists[tname], clist)])
             ts = dict(new_type_state[tname])
             for fname in ts:
                 default = (-1 if pack.is_ref(tc.atype.field_specs[fname])
                            else 0)
-                ts[fname] = ts[fname].at[cols].set(default, mode="drop")
+                if any_sync:
+                    # Sync-constructed spawns (spawn_sync) land their
+                    # constructor's field values; async spawns zero and
+                    # let the constructor message initialise.
+                    vals = jnp.concatenate(
+                        [e[1][fname] if e is not None
+                         else jnp.zeros((cl.shape[0],), ts[fname].dtype)
+                         for e, cl in zip(init_lists[tname], clist)])
+                    val = jnp.where(has_init,
+                                    vals.astype(ts[fname].dtype), default)
+                else:
+                    val = default
+                ts[fname] = ts[fname].at[cols].set(val, mode="drop")
             new_type_state[tname] = ts
 
         # --- 3. route (mesh) or pass through (single chip).
@@ -751,7 +841,61 @@ def build_step(program: Program, opts: RuntimeOptions):
             newly, mute_ovf | res.new_mute_ovf | route_ovf | c1 | c2,
             mute_ovf)
 
+        # --- 5b. per-event trace ring (analysis level 3 only; ≙ the
+        # fork's per-event analysis rows, analysis.c:587-692): record the
+        # tick's TRANSITIONS (mute, unmute, overload-on, spawn, destroy,
+        # error) as (event, actor, step) triples compacted into a bounded
+        # ring the host drains at window boundaries. Traced only when
+        # enabled; and under a cond so event-free ticks skip the
+        # compaction sort.
         occ_after = new_tail - new_head
+        ev_data, ev_count, ev_dropped = (st.ev_data, st.ev_count[0],
+                                         st.ev_dropped[0])
+        if opts.analysis >= 3:
+            released_ev = st.muted & ~muted & alive
+            over_ev = (occ_after > opts.overload_occ) \
+                & ~(occ0 > opts.overload_occ)
+            spawn_ev = alive & ~st.alive
+            destroy_ev = st.alive & ~alive
+            err_ev = jnp.zeros((nl,), jnp.bool_)
+            for s0, errs in error_rows:
+                if errs is None:
+                    continue
+                errf, _ = errs
+                rows_ = s0 + jnp.arange(errf.shape[0], dtype=jnp.int32)
+                err_ev = err_ev.at[rows_].max(errf)
+            classes = [(1, became_muted), (2, released_ev), (3, over_ev),
+                       (4, spawn_ev), (5, destroy_ev), (6, err_ev)]
+            masks = jnp.concatenate([m for _, m in classes])
+            ev_cap = opts.analysis_events
+
+            # A tick can produce at most len(classes)*nl events.
+            k_ev = min(ev_cap, masks.shape[0])
+
+            def record(_):
+                codes = jnp.concatenate(
+                    [jnp.full((nl,), cde, jnp.int32) for cde, _ in classes])
+                actors = base + jnp.tile(
+                    jnp.arange(nl, dtype=jnp.int32), len(classes))
+                perm2, valid2, total2 = compact_mask(masks, k_ev)
+                pos = ev_count + jnp.arange(k_ev, dtype=jnp.int32)
+                ok = valid2 & (pos < ev_cap)
+                posc = jnp.where(ok, pos, ev_cap)
+                ev = ev_data
+                ev = ev.at[0, posc].set(
+                    jnp.where(ok, codes[perm2], 0), mode="drop")
+                ev = ev.at[1, posc].set(
+                    jnp.where(ok, actors[perm2], 0), mode="drop")
+                ev = ev.at[2, posc].set(
+                    jnp.full((k_ev,), st.step_no[0] + 1), mode="drop")
+                return (ev, jnp.minimum(ev_count + total2, ev_cap),
+                        ev_dropped + jnp.maximum(
+                            0, ev_count + total2 - ev_cap))
+
+            ev_data, ev_count, ev_dropped = lax.cond(
+                jnp.any(masks), record,
+                lambda _: (ev_data, ev_count, ev_dropped), operand=None)
+
         nrej_new = st.n_rejected[0] + res.n_rejected
         nbad_new = st.n_badmsg[0] + nbad_total
         ndl_new = st.n_deadletter[0] + res.n_deadletter
@@ -836,6 +980,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_collected=st.n_collected,
             last_error=last_error,
             n_errors=vec(st.n_errors[0] + n_errors),
+            ev_data=ev_data, ev_count=vec(ev_count),
+            ev_dropped=vec(ev_dropped),
             plan_key=res.plan_key, plan_perm=res.plan_perm,
             plan_bounds=res.plan_bounds,
             type_state=new_type_state,

@@ -217,6 +217,8 @@ def build_gc(program: Program, opts: RuntimeOptions):
             n_collected=st.n_collected + n_dead.reshape(1),
             last_error=jnp.where(dead, 0, st.last_error),
             n_errors=st.n_errors,
+            ev_data=st.ev_data, ev_count=st.ev_count,
+            ev_dropped=st.ev_dropped,
             # Plan cache passes through: next step's key vector is
             # computed against the new `alive`, so deliveries to
             # collected actors invalidate it by comparison, not here.

@@ -142,6 +142,14 @@ class RtState:
     #                              (0 = none; ≙ fork's pony_error_code)
     n_errors: jnp.ndarray     # [P] int32 — error_int events
 
+    # Per-event trace ring (analysis level 3; ≙ the fork's per-event
+    # analysis rows, analysis.c:587-692): row0 = event id (analysis.py
+    # EVENT_NAMES), row1 = actor gid, row2 = step. Zero-length when
+    # analysis < 3 (the lanes compile away).
+    ev_data: jnp.ndarray      # [3, P*EV] int32
+    ev_count: jnp.ndarray     # [P] int32 — valid entries since last drain
+    ev_dropped: jnp.ndarray   # [P] int32 — lifetime overflow drops
+
     # Cached delivery plan (see delivery.py): when consecutive ticks carry
     # the same (target, level) key vector — any topology-stable traffic —
     # the sort permutation and segment bounds are reused instead of
@@ -213,6 +221,11 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_collected=jnp.zeros((p,), i32),
         last_error=jnp.zeros((n,), i32),
         n_errors=jnp.zeros((p,), i32),
+        ev_data=jnp.zeros(
+            (3, p * (opts.analysis_events if opts.analysis >= 3 else 0)),
+            i32),
+        ev_count=jnp.zeros((p,), i32),
+        ev_dropped=jnp.zeros((p,), i32),
         plan_key=jnp.full((p * n_entries,), -1, i32),
         plan_perm=jnp.zeros((p * n_entries,), i32),
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),

@@ -71,3 +71,76 @@ def test_signal_dump_handler(tmp_path, capfd):
     a.close()
     signal.signal(signal.SIGUSR1, signal.SIG_DFL)
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+def test_level3_per_event_rows(tmp_path):
+    """Level 3 = per-event lane (≙ the fork's per-event analysis rows,
+    analysis.c:587-692): a program that spawns, destroys, mutes and
+    errors must leave one row per transition in the events CSV."""
+    from ponyc_tpu import I32, Ref, actor, behaviour
+
+    @actor
+    class Child:
+        x: I32
+
+        @behaviour
+        def init(self, st, v: I32):
+            self.error_int(7, when=v == 1)
+            self.destroy(when=v == 1)
+            return {**st, "x": v}
+
+    @actor
+    class Boss:
+        SPAWNS = {"Child": 1}
+        made: I32
+
+        @behaviour
+        def make(self, st, v: I32):
+            self.spawn(Child.init, v)
+            return {**st, "made": st["made"] + 1}
+
+    @actor
+    class Slow:
+        total: I32
+        BATCH = 1
+
+        @behaviour
+        def eat(self, st, v: I32):
+            return {**st, "total": st["total"] + v}
+
+    @actor
+    class Flood:
+        out: Ref[Slow]
+        left: I32
+        MAX_SENDS = 2
+
+        @behaviour
+        def go(self, st, _: I32):
+            self.send(st["out"], Slow.eat, 1, when=st["left"] > 0)
+            self.send(self.actor_id, Flood.go, 0, when=st["left"] > 1)
+            return {**st, "left": st["left"] - 1}
+
+    path = str(tmp_path / "an3.csv")
+    opts = RuntimeOptions(mailbox_cap=4, batch=2, max_sends=2, msg_words=2,
+                          spill_cap=256, inject_slots=64, analysis=3,
+                          analysis_path=path)
+    rt = Runtime(opts)
+    rt.declare(Boss, 1).declare(Child, 4).declare(Slow, 1) \
+      .declare(Flood, 8).start()
+    boss = rt.spawn(Boss)
+    sink = rt.spawn(Slow)
+    floods = rt.spawn_many(Flood, 8, out=int(sink), left=6)
+    rt.send(boss, Boss.make, 1)      # spawn + error + destroy
+    for f in floods:
+        rt.send(int(f), Flood.go, 0)  # overload + mute + unmute
+    rt.run(max_steps=400)
+    rt.stop()
+    ev_path = path + ".events.csv"
+    assert os.path.exists(ev_path)
+    lines = open(ev_path).read().strip().split("\n")
+    assert lines[0].split(",") == analysis.EVENT_COLUMNS
+    events = [l.split(",")[2] for l in lines[1:]]
+    for want in ("SPAWN", "DESTROY", "ERROR", "MUTE", "UNMUTE",
+                 "OVERLOAD"):
+        assert want in events, (want, sorted(set(events)))
+    assert rt.state_of(int(sink))["total"] == 8 * 6

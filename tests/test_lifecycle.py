@@ -170,3 +170,83 @@ def test_spawn_on_mesh_stays_shard_local():
         child = rt.state_of(int(b))["last_child"]
         assert child // nl == int(b) // nl
         assert rt.state_of(int(b))["n_started"] == 2
+
+
+def test_spawn_sync_constructs_fields_synchronously():
+    """≙ the fork's pony_sendv_synchronous_constructor (actor.c:836-848):
+    the constructor runs inside the spawning dispatch and the newborn's
+    fields are set at claim time — a same-step probe message dispatched
+    next tick must see constructed state, with no constructor-message
+    ordering involved."""
+    from ponyc_tpu import F32
+
+    @actor
+    class Kid2:
+        tag: I32
+        frac: F32
+        boss: Ref
+
+        @behaviour
+        def init(self, st, tag: I32, frac: F32):
+            return {**st, "tag": tag, "frac": frac,
+                    "boss": self.actor_id * 0 - 1}
+
+        @behaviour
+        def probe(self, st, bump: I32):
+            return {**st, "tag": st["tag"] + bump}
+
+    @actor
+    class Maker2:
+        made: Ref
+        MAX_SENDS = 1
+        SPAWNS = {"Kid2": 1}
+
+        @behaviour
+        def make(self, st, v: I32):
+            ref = self.spawn_sync(Kid2.init, v, 0.5)
+            # Same-step send to the newborn: arrives AFTER construction
+            # by definition (fields written at claim time this tick).
+            self.send(ref, Kid2.probe, 100, when=ref >= 0)
+            return {**st, "made": ref}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, max_sends=1,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Maker2, 1).declare(Kid2, 2).start()
+    m = rt.spawn(Maker2)
+    rt.send(m, Maker2.make, 7)
+    assert rt.run(max_steps=10) == 0
+    kid = rt.state_of(m)["made"]
+    assert kid >= 0
+    st = rt.state_of(int(kid))
+    assert st["tag"] == 7 + 100        # constructed, then probed
+    assert st["frac"] == 0.5
+    assert st["boss"] == -1
+
+
+def test_spawn_sync_rejects_effectful_constructor():
+    @actor
+    class Kid3:
+        x: I32
+
+        @behaviour
+        def init(self, st, v: I32):
+            self.exit(1)                # effect: not a pure constructor
+            return {**st, "x": v}
+
+    @actor
+    class Maker3:
+        MAX_SENDS = 1
+        SPAWNS = {"Kid3": 1}
+
+        @behaviour
+        def make(self, st, v: I32):
+            self.spawn_sync(Kid3.init, v)
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=2, inject_slots=8))
+    rt.declare(Maker3, 1).declare(Kid3, 1).start()
+    m = rt.spawn(Maker3)
+    rt.send(m, Maker3.make, 1)
+    with pytest.raises(TypeError, match="effects"):
+        rt.run(max_steps=4)
