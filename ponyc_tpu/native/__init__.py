@@ -137,6 +137,34 @@ def lib() -> ctypes.CDLL:
         l.ponyx_os_shutdown.argtypes = [c.c_int32]
         l.ponyx_os_close.restype = c.c_int32
         l.ponyx_os_close.argtypes = [c.c_int32]
+        l.ponyx_os_writev.restype = c.c_int32
+        l.ponyx_os_writev.argtypes = [c.c_int32, c.POINTER(u8p),
+                                      c.POINTER(c.c_int32), c.c_int32]
+        l.ponyx_os_multicast_join.restype = c.c_int32
+        l.ponyx_os_multicast_join.argtypes = [c.c_int32, c.c_char_p,
+                                              c.c_char_p]
+        l.ponyx_os_multicast_leave.restype = c.c_int32
+        l.ponyx_os_multicast_leave.argtypes = [c.c_int32, c.c_char_p,
+                                               c.c_char_p]
+        l.ponyx_os_multicast_ttl.restype = c.c_int32
+        l.ponyx_os_multicast_ttl.argtypes = [c.c_int32, c.c_int32]
+        l.ponyx_os_multicast_loopback.restype = c.c_int32
+        l.ponyx_os_multicast_loopback.argtypes = [c.c_int32, c.c_int32]
+        l.ponyx_os_broadcast.restype = c.c_int32
+        l.ponyx_os_broadcast.argtypes = [c.c_int32, c.c_int32]
+        l.ponyx_os_setsockopt_int.restype = c.c_int32
+        l.ponyx_os_setsockopt_int.argtypes = [c.c_int32, c.c_int32,
+                                              c.c_int32, c.c_int32]
+        l.ponyx_os_getsockopt_int.restype = c.c_int32
+        l.ponyx_os_getsockopt_int.argtypes = [c.c_int32, c.c_int32,
+                                              c.c_int32,
+                                              c.POINTER(c.c_int32)]
+        l.ponyx_os_sockname.restype = c.c_int32
+        l.ponyx_os_sockname.argtypes = [c.c_int32, c.c_char_p, c.c_int32,
+                                        c.POINTER(c.c_int32)]
+        l.ponyx_os_peername.restype = c.c_int32
+        l.ponyx_os_peername.argtypes = [c.c_int32, c.c_char_p, c.c_int32,
+                                        c.POINTER(c.c_int32)]
 
         l.ponyx_os_process_spawn.restype = c.c_int64
         l.ponyx_os_process_spawn.argtypes = [
@@ -256,6 +284,85 @@ class sockets:
     @classmethod
     def keepalive(cls, fd: int, secs: int) -> None:
         cls._ck(lib().ponyx_os_keepalive(fd, secs))
+
+    @classmethod
+    def writev(cls, fd: int, chunks) -> int:
+        """Scatter-gather send of a chunk list without flattening
+        (≙ the reference's iovec writev path, lang/socket.c): one
+        sendmsg carries up to 64 chunks straight out of the caller's
+        buffers. Returns bytes accepted (may end mid-chunk); 0 when the
+        kernel buffer is full."""
+        chunks = [bytes(c) for c in chunks if c]
+        if not chunks:
+            return 0
+        n = min(len(chunks), 64)
+        c = ctypes
+        # Zero-copy: bytes are immutable and kept alive by `chunks` for
+        # the duration of the (read-only) sendmsg, so point straight at
+        # their buffers instead of memcpy-ing every retry.
+        ptrs = (c.POINTER(c.c_uint8) * n)(
+            *[c.cast(c.c_char_p(ch), c.POINTER(c.c_uint8))
+              for ch in chunks[:n]])
+        lens = (c.c_int32 * n)(*[len(ch) for ch in chunks[:n]])
+        r = lib().ponyx_os_writev(fd, ptrs, lens, n)
+        if r == -cls.EAGAIN:
+            return 0
+        return cls._ck(r)
+
+    @classmethod
+    def multicast_join(cls, fd: int, group: str, iface: str = "") -> None:
+        """Join a multicast group, IPv4 or IPv6 by the group address
+        (≙ pony_os_multicast_join)."""
+        cls._ck(lib().ponyx_os_multicast_join(fd, group.encode(),
+                                              iface.encode()))
+
+    @classmethod
+    def multicast_leave(cls, fd: int, group: str, iface: str = "") -> None:
+        cls._ck(lib().ponyx_os_multicast_leave(fd, group.encode(),
+                                               iface.encode()))
+
+    @classmethod
+    def multicast_ttl(cls, fd: int, ttl: int) -> None:
+        cls._ck(lib().ponyx_os_multicast_ttl(fd, ttl))
+
+    @classmethod
+    def multicast_loopback(cls, fd: int, on: bool = True) -> None:
+        cls._ck(lib().ponyx_os_multicast_loopback(fd, int(on)))
+
+    @classmethod
+    def broadcast(cls, fd: int, on: bool = True) -> None:
+        cls._ck(lib().ponyx_os_broadcast(fd, int(on)))
+
+    @classmethod
+    def set_option(cls, fd: int, level: int, name: int,
+                   value: int) -> None:
+        """Generic int socket option (≙ the reference's per-option
+        pony_os_getsockopt surface collapsed to (level, name, int));
+        levels/names are the OS constants (socket module)."""
+        cls._ck(lib().ponyx_os_setsockopt_int(fd, level, name, value))
+
+    @classmethod
+    def get_option(cls, fd: int, level: int, name: int) -> int:
+        out = ctypes.c_int32(0)
+        cls._ck(lib().ponyx_os_getsockopt_int(fd, level, name,
+                                              ctypes.byref(out)))
+        return int(out.value)
+
+    @classmethod
+    def sockname(cls, fd: int):
+        """(addr, port) of the local end — IPv4 dotted or IPv6 hex."""
+        addr = ctypes.create_string_buffer(64)
+        port = ctypes.c_int32(0)
+        cls._ck(lib().ponyx_os_sockname(fd, addr, 64, ctypes.byref(port)))
+        return addr.value.decode(), int(port.value)
+
+    @classmethod
+    def peername(cls, fd: int):
+        """(addr, port) of the remote end."""
+        addr = ctypes.create_string_buffer(64)
+        port = ctypes.c_int32(0)
+        cls._ck(lib().ponyx_os_peername(fd, addr, 64, ctypes.byref(port)))
+        return addr.value.decode(), int(port.value)
 
     @classmethod
     def shutdown(cls, fd: int) -> None:

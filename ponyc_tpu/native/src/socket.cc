@@ -16,6 +16,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <net/if.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -265,6 +266,196 @@ int32_t ponyx_os_shutdown(int32_t fd) {
 int32_t ponyx_os_close(int32_t fd) {
   if (close(fd) != 0) return -errno;
   return 0;
+}
+
+// Scatter-gather write (≙ pony_os_writev + the reference's iovec chunk
+// lists, socket.c — stdlib writev sends a chunk list without flattening).
+// bufs/lens describe n chunks; returns total bytes written (possibly
+// short, ending mid-chunk) or -errno.
+int32_t ponyx_os_writev(int32_t fd, const uint8_t** bufs,
+                        const int32_t* lens, int32_t n) {
+  if (n <= 0) return 0;
+  if (n > 64) n = 64;                    // IOV_MAX-safe static bound
+  struct iovec iov[64];
+  for (int i = 0; i < n; i++) {
+    iov[i].iov_base = const_cast<uint8_t*>(bufs[i]);
+    iov[i].iov_len = size_t(lens[i]);
+  }
+  struct msghdr mh;
+  memset(&mh, 0, sizeof(mh));
+  mh.msg_iov = iov;
+  mh.msg_iovlen = size_t(n);
+  ssize_t w = sendmsg(fd, &mh, MSG_NOSIGNAL);
+  if (w >= 0) return int32_t(w);
+  int e = errno;
+  return (e == EAGAIN || e == EWOULDBLOCK) ? -EAGAIN : -e;
+}
+
+namespace {
+
+// Multicast group membership, IPv4 or IPv6 by the group address family
+// (≙ pony_os_multicast_join / pony_os_multicast_leave, socket.c —
+// which also dispatch on family). iface: interface address (IPv4) or
+// index name (IPv6), empty = any.
+int32_t multicast_op(int32_t fd, const char* group, const char* iface,
+                     bool join) {
+  struct in_addr g4;
+  struct in6_addr g6;
+  if (inet_pton(AF_INET, group, &g4) == 1) {
+    struct ip_mreq req;
+    memset(&req, 0, sizeof(req));
+    req.imr_multiaddr = g4;
+    if (iface && iface[0]) {
+      if (inet_pton(AF_INET, iface, &req.imr_interface) != 1)
+        return -EINVAL;
+    } else {
+      req.imr_interface.s_addr = htonl(INADDR_ANY);
+    }
+    int op = join ? IP_ADD_MEMBERSHIP : IP_DROP_MEMBERSHIP;
+    if (setsockopt(fd, IPPROTO_IP, op, &req, sizeof(req)) != 0)
+      return -errno;
+    return 0;
+  }
+  if (inet_pton(AF_INET6, group, &g6) == 1) {
+    struct ipv6_mreq req;
+    memset(&req, 0, sizeof(req));
+    req.ipv6mr_multiaddr = g6;
+    if (iface && iface[0]) {
+      unsigned idx = if_nametoindex(iface);
+      if (idx == 0) return -EINVAL;
+      req.ipv6mr_interface = idx;
+    } else {
+      req.ipv6mr_interface = 0;         // any
+    }
+    int op = join ? IPV6_JOIN_GROUP : IPV6_LEAVE_GROUP;
+    if (setsockopt(fd, IPPROTO_IPV6, op, &req, sizeof(req)) != 0)
+      return -errno;
+    return 0;
+  }
+  return -EINVAL;
+}
+
+// The socket's address family (for v4/v6 option dispatch below).
+int sock_family(int fd) {
+  int dom = 0;
+  socklen_t len = sizeof(dom);
+  if (getsockopt(fd, SOL_SOCKET, SO_DOMAIN, &dom, &len) != 0)
+    return -errno;
+  return dom;
+}
+
+// Family-aware name formatting shared by sockname/peername.
+int32_t format_name(struct sockaddr_storage* ss, char* addr_out,
+                    int32_t addr_cap, int32_t* port_out) {
+  if (addr_out == nullptr || addr_cap < 2) return -EINVAL;
+  addr_out[0] = 0;
+  if (ss->ss_family == AF_INET) {
+    auto* a = reinterpret_cast<struct sockaddr_in*>(ss);
+    inet_ntop(AF_INET, &a->sin_addr, addr_out, addr_cap);
+    if (port_out) *port_out = ntohs(a->sin_port);
+    return 0;
+  }
+  if (ss->ss_family == AF_INET6) {
+    auto* a = reinterpret_cast<struct sockaddr_in6*>(ss);
+    inet_ntop(AF_INET6, &a->sin6_addr, addr_out, addr_cap);
+    if (port_out) *port_out = ntohs(a->sin6_port);
+    return 0;
+  }
+  return -EAFNOSUPPORT;
+}
+
+}  // namespace
+
+int32_t ponyx_os_multicast_join(int32_t fd, const char* group,
+                                const char* iface) {
+  return multicast_op(fd, group, iface, true);
+}
+
+int32_t ponyx_os_multicast_leave(int32_t fd, const char* group,
+                                 const char* iface) {
+  return multicast_op(fd, group, iface, false);
+}
+
+// ≙ pony_os_multicast_ttl / _loopback (socket.c): scope + self-delivery
+// of outgoing multicast datagrams; dispatched on the socket family like
+// the join path (IPv6 wants IPPROTO_IPV6 hop-limit/loop options).
+int32_t ponyx_os_multicast_ttl(int32_t fd, int32_t ttl) {
+  int fam = sock_family(fd);
+  if (fam < 0) return fam;
+  if (fam == AF_INET6) {
+    int v = ttl;
+    if (setsockopt(fd, IPPROTO_IPV6, IPV6_MULTICAST_HOPS, &v,
+                   sizeof(v)) != 0)
+      return -errno;
+    return 0;
+  }
+  unsigned char v = (unsigned char)ttl;
+  if (setsockopt(fd, IPPROTO_IP, IP_MULTICAST_TTL, &v, sizeof(v)) != 0)
+    return -errno;
+  return 0;
+}
+
+int32_t ponyx_os_multicast_loopback(int32_t fd, int32_t on) {
+  int fam = sock_family(fd);
+  if (fam < 0) return fam;
+  if (fam == AF_INET6) {
+    int v = on ? 1 : 0;
+    if (setsockopt(fd, IPPROTO_IPV6, IPV6_MULTICAST_LOOP, &v,
+                   sizeof(v)) != 0)
+      return -errno;
+    return 0;
+  }
+  unsigned char v = on ? 1 : 0;
+  if (setsockopt(fd, IPPROTO_IP, IP_MULTICAST_LOOP, &v, sizeof(v)) != 0)
+    return -errno;
+  return 0;
+}
+
+// ≙ pony_os_broadcast.
+int32_t ponyx_os_broadcast(int32_t fd, int32_t on) {
+  int v = on ? 1 : 0;
+  if (setsockopt(fd, SOL_SOCKET, SO_BROADCAST, &v, sizeof(v)) != 0)
+    return -errno;
+  return 0;
+}
+
+// Generic int-valued socket options (≙ the reference's ~600-line
+// per-option get/getsockopt surface, socket.c pony_os_getsockopt* —
+// collapsed to one pair since options are (level, name, int) triples).
+int32_t ponyx_os_setsockopt_int(int32_t fd, int32_t level, int32_t name,
+                                int32_t value) {
+  if (setsockopt(fd, level, name, &value, sizeof(value)) != 0)
+    return -errno;
+  return 0;
+}
+
+int32_t ponyx_os_getsockopt_int(int32_t fd, int32_t level, int32_t name,
+                                int32_t* value_out) {
+  int v = 0;
+  socklen_t len = sizeof(v);
+  if (getsockopt(fd, level, name, &v, &len) != 0) return -errno;
+  if (value_out) *value_out = v;
+  return 0;
+}
+
+// Full local/peer names: "addr" string (IPv4 dotted or IPv6 hex) + port
+// (≙ pony_os_sockname / pony_os_peername with their IPv6 handling).
+int32_t ponyx_os_sockname(int32_t fd, char* addr_out, int32_t addr_cap,
+                          int32_t* port_out) {
+  struct sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen) != 0)
+    return -errno;
+  return format_name(&ss, addr_out, addr_cap, port_out);
+}
+
+int32_t ponyx_os_peername(int32_t fd, char* addr_out, int32_t addr_cap,
+                          int32_t* port_out) {
+  struct sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen) != 0)
+    return -errno;
+  return format_name(&ss, addr_out, addr_cap, port_out);
 }
 
 }  // extern "C"

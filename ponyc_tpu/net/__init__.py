@@ -28,6 +28,8 @@ applications can throttle (≙ packages/net throttled/unthrottled).
 
 from __future__ import annotations
 
+import collections
+
 from typing import Dict, Optional, Tuple
 
 from .. import native
@@ -37,7 +39,7 @@ from ..native import sockets as S
 
 class _Conn:
     __slots__ = ("fd", "sub", "owner", "on_connect", "on_data", "on_closed",
-                 "outbuf", "connecting", "closed")
+                 "outbuf", "outbuf_len", "connecting", "closed")
 
     def __init__(self, fd, owner, on_connect, on_data, on_closed,
                  connecting):
@@ -47,7 +49,8 @@ class _Conn:
         self.on_connect = on_connect
         self.on_data = on_data
         self.on_closed = on_closed
-        self.outbuf = b""
+        self.outbuf = collections.deque()   # chunks (writev scatter-gather)
+        self.outbuf_len = 0
         self.connecting = connecting
         self.closed = False
 
@@ -175,28 +178,48 @@ class Net:
                                      write=bool(c.outbuf))
 
     def _flush(self, cid: int, c: _Conn) -> None:
+        # Scatter-gather flush: one writev per round sends the whole
+        # chunk list without flattening (≙ the reference's iovec write
+        # path, lang/socket.c pony_os_writev).
         while c.outbuf:
-            n = S.send(c.fd, c.outbuf)
+            n = S.writev(c.fd, list(c.outbuf))
             if n <= 0:
                 break
-            c.outbuf = c.outbuf[n:]
+            c.outbuf_len -= n
+            while n > 0 and c.outbuf:
+                head = c.outbuf[0]
+                if n >= len(head):
+                    n -= len(head)
+                    c.outbuf.popleft()
+                else:
+                    c.outbuf[0] = head[n:]
+                    n = 0
         self._arm(c)
 
     # -- user API on connections --
     def send(self, cid: int, data: bytes) -> None:
         """Queue bytes; the layer writes as the socket allows (≙
         TCPConnection.write with host-side pending buffer)."""
+        self.sendv(cid, (data,))
+
+    def sendv(self, cid: int, chunks) -> None:
+        """Queue a chunk LIST (e.g. buffered.Writer.done()) — sent with
+        scatter-gather writev, no flattening (≙ TCPConnection.writev)."""
         c = self._conns.get(cid)
         if c is None or c.closed:
             raise KeyError(f"connection {cid} is closed")
-        c.outbuf += bytes(data)
+        for ch in chunks:
+            ch = bytes(ch)
+            if ch:
+                c.outbuf.append(ch)
+                c.outbuf_len += len(ch)
         if not c.connecting:
             self._flush(cid, c)
 
     def pending(self, cid: int) -> int:
         """Unflushed outgoing bytes (backpressure signal ≙ throttled)."""
         c = self._conns.get(cid)
-        return len(c.outbuf) if c is not None else 0
+        return c.outbuf_len if c is not None else 0
 
     def set_conn_owner(self, cid: int, owner: int, *,
                        on_data: BehaviourDef,
