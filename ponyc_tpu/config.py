@@ -66,6 +66,11 @@ class RuntimeOptions:
     #   overhead, lower to tighten max_steps granularity
     cd_interval: int = 128         # steps between cycle-detector scans
     #   (≙ --ponycdinterval default 100ms, start.c:206)
+    gc_initial: int = 1 << 14      # host-heap bytes allocated since the
+    #   last collection that trigger one early (≙ --ponygcinitial
+    #   2^14, start.c:204-209 — growth-triggered GC, heap.c:603-806)
+    gc_factor: float = 2.0         # next-trigger growth multiplier over
+    #   live bytes after a collection (≙ --ponygcfactor 2.0)
     noblock: bool = False          # ≙ --ponynoblock: disable cycle detection
     gc_max_iters: int = 0          # reachability-trace hop cap (0 = run to
     #   fixpoint); if hit, that GC round collects nothing (safe)
@@ -86,6 +91,10 @@ class RuntimeOptions:
     analysis_path: str = "/tmp/pony_tpu.analytics.csv"
     analysis_events: int = 4096    # device event-ring entries per shard
     #   (level 3); overflow between two drains drops and counts
+    pallas: bool = False           # route the dispatch mailbox drain
+    #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
+    #   XLA select-chain; interpret-mode on CPU. Off until measured
+    #   faster on the real chip.
     debug_checks: bool = False     # run Runtime.check_invariants() at
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)

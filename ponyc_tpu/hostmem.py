@@ -18,6 +18,7 @@ Accounting mirrors the reference's USE_MEMTRACK counters
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Dict
 
 
@@ -29,10 +30,28 @@ class HostHeap:
 
     def __init__(self):
         self._objs: Dict[int, Any] = {}
+        self._sizes: Dict[int, int] = {}
         self._next = 1
         self.boxed = 0
         self.unboxed = 0
         self.peak_live = 0
+        # Growth accounting (≙ the per-actor heap's used/next_gc fields,
+        # mem/heap.c:603-806): the runtime's run loop triggers an early
+        # collection when bytes_since_gc outgrows its threshold
+        # (RuntimeOptions.gc_initial / gc_factor), exactly the
+        # growth-triggered cadence of the reference. Sizes are shallow
+        # (sys.getsizeof) — an accounting signal, not an allocator.
+        self.bytes_live = 0
+        self.bytes_since_gc = 0
+
+    _MISSING = object()
+
+    @staticmethod
+    def _approx_size(obj: Any) -> int:
+        try:
+            return max(1, sys.getsizeof(obj))
+        except TypeError:
+            return 64
 
     def box(self, obj: Any) -> int:
         h = self._next
@@ -42,6 +61,10 @@ class HostHeap:
         while self._next in self._objs:
             self._next += 1
         self._objs[h] = obj
+        sz = self._approx_size(obj)
+        self._sizes[h] = sz
+        self.bytes_live += sz
+        self.bytes_since_gc += sz
         self.boxed += 1
         self.peak_live = max(self.peak_live, len(self._objs))
         return h
@@ -50,6 +73,7 @@ class HostHeap:
         """Take ownership (the handle dies). KeyError on double-take —
         the dynamic cousin of Pony rejecting use-after-send of an iso."""
         obj = self._objs.pop(int(handle))
+        self.bytes_live -= self._sizes.pop(int(handle), 0)
         self.unboxed += 1
         return obj
 
@@ -57,7 +81,9 @@ class HostHeap:
         return self._objs[int(handle)]
 
     def drop(self, handle: int) -> None:
-        if self._objs.pop(int(handle), None) is not None:
+        if self._objs.pop(int(handle), HostHeap._MISSING) \
+                is not HostHeap._MISSING:
+            self.bytes_live -= self._sizes.pop(int(handle), 0)
             self.unboxed += 1
 
     @property
@@ -66,4 +92,6 @@ class HostHeap:
 
     def stats(self) -> Dict[str, int]:
         return {"boxed": self.boxed, "unboxed": self.unboxed,
-                "live": self.live, "peak_live": self.peak_live}
+                "live": self.live, "peak_live": self.peak_live,
+                "bytes_live": self.bytes_live,
+                "bytes_since_gc": self.bytes_since_gc}

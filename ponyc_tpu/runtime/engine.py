@@ -346,10 +346,19 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
         def busy_fn(_):
             n_run = jnp.where(runnable_rows,
                               jnp.minimum(occ_rows, batch), 0)
-            msgs = jnp.stack([_ring_take(buf_rows, (head_rows + k) % cap)
-                              for k in range(batch)])   # [batch, w1, rows]
-            valids = (jnp.arange(batch, dtype=jnp.int32)[:, None]
-                      < n_run[None, :])                 # [batch, rows]
+            if opts.pallas:          # gate BEFORE importing pallas/mosaic
+                from ..ops import mailbox_kernel as mk
+            if opts.pallas and (rows <= mk.LANE_BLOCK
+                                or rows % mk.LANE_BLOCK == 0):
+                msgs, valids = mk.drain_msgs(
+                    buf_rows, head_rows, n_run, batch=batch,
+                    interpret=mk.interpret_mode())
+            else:
+                msgs = jnp.stack(
+                    [_ring_take(buf_rows, (head_rows + k) % cap)
+                     for k in range(batch)])            # [batch, w1, rows]
+                valids = (jnp.arange(batch, dtype=jnp.int32)[:, None]
+                          < n_run[None, :])             # [batch, rows]
             z = lambda d: jnp.zeros((rows,), d)         # noqa: E731
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
                       z(jnp.int32), z(jnp.bool_), z(jnp.bool_),

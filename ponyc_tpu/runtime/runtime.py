@@ -155,6 +155,7 @@ class Runtime:
         self._ref_mask = None
         self._ever_released = False
         self._last_gc_step = 0
+        self._next_gc = self.opts.gc_initial   # ≙ heap.c next_gc
         self._host_errors: Dict[int, int] = {}
 
     # Any state assignment — including a driver pushing rt._step results
@@ -371,6 +372,14 @@ class Runtime:
         self.totals["gc_runs"] += 1
         if not bool(converged):
             self.totals["gc_aborted"] += 1
+        # Growth-triggered accounting reset (≙ heap.c's next_gc update
+        # after a collection) — here so every collection path, manual
+        # included, clears the allocation-pressure signal consistently.
+        heap = getattr(self, "_heap", None)
+        if heap is not None:
+            heap.bytes_since_gc = 0
+            self._next_gc = max(self.opts.gc_initial,
+                                int(heap.bytes_live * self.opts.gc_factor))
         return self.counter("n_collected") - before
 
     def _replace(self, **kw) -> RtState:
@@ -731,11 +740,19 @@ class Runtime:
             # scheduler-0 idle path every --ponycdinterval,
             # scheduler.c:976-989) — only when something can actually be
             # garbage: a host ref was released or actors spawn on device.
-            if (not self.opts.noblock and self.opts.cd_interval > 0
+            # Host-heap allocation pressure schedules a collection EARLY
+            # (≙ the per-actor heap's growth-triggered GC, heap.c next_gc
+            # with --ponygcinitial/--ponygcfactor, start.c:204-209).
+            heap = getattr(self, "_heap", None)
+            heap_pressure = (heap is not None
+                             and heap.bytes_since_gc > self._next_gc)
+            if (not self.opts.noblock
                     and (self._ever_released
                          or self.program.has_device_spawns)
-                    and (self.steps_run - self._last_gc_step
-                         >= self.opts.cd_interval)):
+                    and (heap_pressure
+                         or (self.opts.cd_interval > 0
+                             and self.steps_run - self._last_gc_step
+                             >= self.opts.cd_interval))):
                 self._last_gc_step = self.steps_run
                 self.gc()
             if self._exit_requested:

@@ -145,3 +145,40 @@ def test_gc_on_mesh_crosses_shards():
     rt.release([ids[order[0]]])
     assert rt.gc() == 16
     assert np.asarray(rt.state.alive).sum() == 0
+
+
+def test_heap_pressure_triggers_early_collection():
+    """Host-heap allocation growth schedules a collection before
+    cd_interval elapses (≙ the growth-triggered per-actor heap GC,
+    mem/heap.c next_gc with --ponygcinitial/--ponygcfactor)."""
+    from ponyc_tpu import Runtime, RuntimeOptions, actor, behaviour, I32
+
+    @actor
+    class Lonely:
+        x: I32
+
+        @behaviour
+        def tick(self, st, v: I32):
+            return {**st, "x": v}
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=2,
+                          inject_slots=8, cd_interval=10_000,
+                          gc_initial=1 << 12)
+    rt = Runtime(opts)
+    rt.declare(Lonely, 4).start()
+    a = rt.spawn(Lonely)
+    garbage = rt.spawn(Lonely)
+    rt.release(garbage)                 # unreachable → collectable
+    assert rt.counter("n_collected") == 0
+    # Allocate past gc_initial on the host heap, then run a few steps:
+    # pressure must fire the collection long before cd_interval=10000.
+    for _ in range(8):
+        rt.heap.box(b"x" * 1024)
+    for _ in range(3):
+        rt.send(a, Lonely.tick, 1)
+        rt.run(max_steps=4)
+        if rt.counter("n_collected"):
+            break
+    assert rt.counter("n_collected") == 1
+    assert rt.heap.bytes_since_gc == 0      # accounting reset
+    assert rt.heap.stats()["bytes_live"] > 8 * 1024
