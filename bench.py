@@ -3,10 +3,11 @@
 
 Reproduces the reference's `examples/message-ubench` metric
 (actor-messages/sec; BASELINE.md) at benchmark scale: N pingers in one
-shuffled cycle, one message in flight per actor, sustained. Each jitted
-tick dispatches exactly N behaviours and routes N messages, so
+shuffled cycle, `--pings` messages in flight per actor (≙ the reference's
+--initial-pings, default 5 there), sustained. Each jitted tick dispatches
+exactly N×pings behaviours and routes N×pings messages, so
 
-    msgs/sec = N × ticks / elapsed.
+    msgs/sec = N × pings × ticks / elapsed.
 
 Also measures the second tracked BASELINE metric: p50 behaviour-dispatch
 latency, via a single-token 1024-actor ring (≙ examples/ring/main.pony) —
@@ -95,13 +96,16 @@ def bench_ubench(args):
     from ponyc_tpu import RuntimeOptions
     from ponyc_tpu.models import ubench
 
-    # cap 4 suffices for the 1-in-flight steady state and keeps the ring
-    # rebuild (cap-proportional) lean.
-    opts = RuntimeOptions(mailbox_cap=args.cap, batch=1, max_sends=1,
+    # cap must hold the sustained in-flight pings per pinger (≙ the
+    # reference's --initial-pings, default 5 there); the ring rebuild is
+    # cap-proportional so keep it at the smallest power of two that fits.
+    pings = args.pings
+    cap = max(args.cap, 1 << (pings - 1).bit_length())
+    opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
                           msg_words=1, spill_cap=1024, inject_slots=8)
     t0 = time.time()
-    rt, ids = ubench.build(args.actors, opts)
-    ubench.seed_all(rt, ids, hops=1 << 30)   # effectively infinite
+    rt, ids = ubench.build(args.actors, opts, pings=pings)
+    ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)  # ~infinite
     build_s = time.time() - t0
 
     # Drive the fused window directly (engine.build_multi_step): one
@@ -130,9 +134,10 @@ def bench_ubench(args):
     rt.state = state
 
     processed = rt.counter("n_processed") & 0xFFFFFFFF
-    expect = (warm_windows * K + ticks) * args.actors
+    expect = (warm_windows * K + ticks) * args.actors * pings
     return {
-        "msgs_per_sec": args.actors * ticks / elapsed,
+        "msgs_per_sec": args.actors * pings * ticks / elapsed,
+        "pings": pings,
         "elapsed_s": elapsed,
         "tick_ms": 1e3 * elapsed / ticks,
         "ticks": ticks,
@@ -190,6 +195,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=64)
     ap.add_argument("--cap", type=int,
                     default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
+    ap.add_argument("--pings", type=int,
+                    default=int(os.environ.get("PONY_TPU_BENCH_PINGS", 4)))
     ap.add_argument("--lat-actors", type=int, default=1024)
     ap.add_argument("--lat-ticks", type=int, default=200)
     ap.add_argument("--platform",
@@ -236,6 +243,7 @@ def main():
         "detail": {
             "actors": args.actors,
             "ticks": ub["ticks"],
+            "pings": ub["pings"],
             "fused_ticks_per_dispatch": ub["fuse"],
             "elapsed_s": round(ub["elapsed_s"], 4),
             "tick_ms": round(ub["tick_ms"], 3),

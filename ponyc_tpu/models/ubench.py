@@ -5,10 +5,11 @@ exchanging ping messages; the metric is actor-messages/sec).
 TPU shape: pingers are one cohort; each pinger holds a `next_ref` (a
 shuffled permutation so traffic is irregular, like the reference's random
 pings) and on `ping(n)` forwards `ping(n-1)` while n > 0. Seeding every
-pinger once yields a sustained load of exactly N in-flight messages — one
-dispatched message per actor per tick, which is the framework's peak
-message throughput (BASELINE.md north star: ≥10× a 32-core CPU at 1M
-actors on one chip).
+pinger with `pings` messages (≙ the reference's --initial-pings, default
+5 there) yields a sustained load of exactly N×pings in-flight messages —
+`pings` dispatches per actor per tick with the drain batch widened to
+match, so msgs/sec = N × pings / tick (BASELINE.md north star: ≥10× a
+32-core CPU at 1M actors on one chip).
 """
 
 from __future__ import annotations
@@ -33,12 +34,24 @@ class Pinger:
 
 
 def build(n_pingers: int, opts: RuntimeOptions | None = None,
-          permute: bool = True, seed: int = 0):
-    opts = opts or RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
-                                  msg_words=1)
+          permute: bool = True, seed: int = 0, pings: int = 1):
+    """`pings` > 1 sustains that many in-flight messages per pinger (≙ the
+    reference's --initial-pings, default 5 there: main.pony OptionSpec) by
+    widening the cohort's drain batch to match; mailbox_cap must be
+    >= pings."""
+    opts = opts or RuntimeOptions(
+        mailbox_cap=max(8, 1 << (pings - 1).bit_length()),
+        batch=pings, max_sends=1, msg_words=1)
+    if opts.mailbox_cap < pings:
+        raise ValueError("mailbox_cap must be >= pings")
     rt = Runtime(opts)
-    rt.declare(Pinger, n_pingers)
-    rt.start()
+    old_batch = Pinger.BATCH
+    Pinger.BATCH = pings
+    try:
+        rt.declare(Pinger, n_pingers)
+        rt.start()
+    finally:
+        Pinger.BATCH = old_batch
     ids = rt.spawn_many(Pinger, n_pingers)
     if permute:
         rng = np.random.default_rng(seed)
@@ -54,6 +67,7 @@ def build(n_pingers: int, opts: RuntimeOptions | None = None,
     return rt, ids
 
 
-def seed_all(rt: Runtime, ids, hops: int):
-    """Give every pinger an initial ping carrying `hops` remaining."""
-    rt.bulk_send(ids, Pinger.ping, np.full(len(ids), hops, np.int64))
+def seed_all(rt: Runtime, ids, hops: int, pings: int = 1):
+    """Give every pinger `pings` initial pings carrying `hops` remaining."""
+    for _ in range(pings):
+        rt.bulk_send(ids, Pinger.ping, np.full(len(ids), hops, np.int64))

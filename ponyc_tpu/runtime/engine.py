@@ -551,10 +551,15 @@ def build_step(program: Program, opts: RuntimeOptions):
 
         # --- 1. unmute pass (≙ ponyint_sched_unmute_senders,
         # scheduler.c:1552-1635: receiver recovered → senders released).
-        dsp_valid = st.dspill_tgt >= 0
-        dspill_pending = counts_by_key(
-            jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
-            dsp_valid.astype(jnp.int32), nl)
+        # The per-row pending histogram (a scatter-add, which serialises
+        # on TPU) only runs when the spill actually holds messages — the
+        # steady state skips it entirely.
+        dspill_pending = lax.cond(
+            st.dspill_count[0] > 0,
+            lambda _: counts_by_key(
+                jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
+                (st.dspill_tgt >= 0).astype(jnp.int32), nl),
+            lambda _: jnp.zeros((nl,), jnp.int32), operand=None)
         def unmute_pass(_):
             # ≙ ponyint_sched_unmute_senders walking the mutemap
             # receiver-set (scheduler.c:1552-1635): a sender releases only
@@ -841,14 +846,23 @@ def build_step(program: Program, opts: RuntimeOptions):
             return m, jnp.any(both & (a != b), axis=0)
 
         newly = (res.newly_muted | route_muted) & alive
-        inc_refs, c1 = _merge_slots(res.new_mute_refs, route_refs)
-        merged_refs, c2 = _merge_slots(mute_refs, inc_refs)
         became_muted = newly & ~muted
         muted2 = muted | newly
-        mute_refs2 = jnp.where(newly[None, :], merged_refs, mute_refs)
-        mute_ovf2 = jnp.where(
-            newly, mute_ovf | res.new_mute_ovf | route_ovf | c1 | c2,
-            mute_ovf)
+
+        def merge_mutes(_):
+            inc_refs, c1 = _merge_slots(res.new_mute_refs, route_refs)
+            merged_refs, c2 = _merge_slots(mute_refs, inc_refs)
+            return (jnp.where(newly[None, :], merged_refs, mute_refs),
+                    jnp.where(newly,
+                              mute_ovf | res.new_mute_ovf | route_ovf
+                              | c1 | c2,
+                              mute_ovf))
+
+        # The [K, N] slot-table merge only runs on ticks that actually
+        # muted someone (≙ mutemap inserts happening only on mute).
+        mute_refs2, mute_ovf2 = lax.cond(
+            jnp.any(newly), merge_mutes,
+            lambda _: (mute_refs, mute_ovf), operand=None)
 
         # --- 5b. per-event trace ring (analysis level 3 only; ≙ the
         # fork's per-event analysis rows, analysis.c:587-692): record the

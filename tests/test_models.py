@@ -78,3 +78,22 @@ def test_gups_opt_batched_updates():
         x = (x ^ (x << 5)).astype(np.int32)
         expect ^= np.bitwise_xor.reduce(x)
     assert np.bitwise_xor.reduce(cells) == expect
+
+
+def test_ubench_multi_ping_sustains_n_times_pings():
+    """`pings` in-flight messages per pinger (≙ the reference's
+    --initial-pings, examples/message-ubench/main.pony default 5) sustain
+    exactly N*pings dispatches per tick with no overflow."""
+    from ponyc_tpu.models import ubench
+    n, p = 128, 4
+    opts = RuntimeOptions(mailbox_cap=4, batch=p, max_sends=1, msg_words=1,
+                          spill_cap=128, inject_slots=8)
+    rt, ids = ubench.build(n, opts, pings=p)
+    ubench.seed_all(rt, ids, hops=1 << 30, pings=p)
+    st, inj = rt.state, rt._empty_inject
+    for _ in range(6):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    assert rt.counter("n_processed") == 6 * n * p
+    assert not bool(aux.spill_overflow)
+    assert not bool(aux.n_muted_now)
