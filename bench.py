@@ -100,7 +100,7 @@ def bench_ubench(args):
     # reference's --initial-pings, default 5 there); the ring rebuild is
     # cap-proportional so keep it at the smallest power of two that fits.
     pings = args.pings
-    cap = max(args.cap, 1 << (pings - 1).bit_length())
+    cap = ubench.cap_for_pings(pings, floor=args.cap)
     opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
                           msg_words=1, spill_cap=1024, inject_slots=8)
     t0 = time.time()

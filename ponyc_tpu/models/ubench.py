@@ -24,8 +24,7 @@ class Pinger:
     next_ref: Ref
     pings: I32
 
-    BATCH = 1
-    MAX_SENDS = 1
+    MAX_SENDS = 1      # drain batch comes from opts.batch (>= pings)
 
     @behaviour
     def ping(self, st, n: I32):
@@ -33,25 +32,29 @@ class Pinger:
         return {**st, "pings": st["pings"] + 1}
 
 
+def cap_for_pings(pings: int, floor: int = 4) -> int:
+    """Smallest power-of-two mailbox_cap that holds `pings` in-flight
+    messages (shared by build() and bench.py so the sizing rule lives
+    once)."""
+    return max(floor, 1 << max(0, pings - 1).bit_length())
+
+
 def build(n_pingers: int, opts: RuntimeOptions | None = None,
           permute: bool = True, seed: int = 0, pings: int = 1):
     """`pings` > 1 sustains that many in-flight messages per pinger (≙ the
-    reference's --initial-pings, default 5 there: main.pony OptionSpec) by
-    widening the cohort's drain batch to match; mailbox_cap must be
-    >= pings."""
+    reference's --initial-pings, default 5 there: main.pony OptionSpec);
+    opts.batch must be >= pings to drain them and mailbox_cap >= pings to
+    hold them."""
     opts = opts or RuntimeOptions(
-        mailbox_cap=max(8, 1 << (pings - 1).bit_length()),
-        batch=pings, max_sends=1, msg_words=1)
+        mailbox_cap=cap_for_pings(pings, floor=8),
+        batch=max(1, pings), max_sends=1, msg_words=1)
     if opts.mailbox_cap < pings:
         raise ValueError("mailbox_cap must be >= pings")
+    if opts.batch < pings:
+        raise ValueError("opts.batch must be >= pings to sustain them")
     rt = Runtime(opts)
-    old_batch = Pinger.BATCH
-    Pinger.BATCH = pings
-    try:
-        rt.declare(Pinger, n_pingers)
-        rt.start()
-    finally:
-        Pinger.BATCH = old_batch
+    rt.declare(Pinger, n_pingers)
+    rt.start()
     ids = rt.spawn_many(Pinger, n_pingers)
     if permute:
         rng = np.random.default_rng(seed)

@@ -96,7 +96,7 @@ def empty_mute_slots(n: int, k: int):
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
             shard_base, mute_slots: int = 4, level=None, n_levels: int = 1,
-            plan=None) -> DeliveryResult:
+            plan=None, pressured=None) -> DeliveryResult:
     """`level` ([E] int32, 0 = most urgent) folds the fork's actor
     *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
     inject) into the one sort: the composite key (target, level, arrival)
@@ -208,13 +208,16 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
                 words=jnp.where(vspill[None, :], wds[:, perm2], 0),
             )
             # Mute triggers (≙ actor.c:898-921 + mute rules
-            # actor.c:1171-1235): a valid send whose receiver rejected it
-            # or is now over the overload threshold mutes the sender —
-            # unless the sender is itself overloaded (the reference's
-            # !OVERLOADED/UNDER_PRESSURE guard, which prevents mute
-            # deadlocks among hot actors). Only senders resident on this
-            # shard can be muted here.
+            # actor.c:1171-1235): a valid send whose receiver rejected it,
+            # is now over the overload threshold, or has DECLARED pressure
+            # (pony_apply_backpressure, actor.c:1137-1162) mutes the
+            # sender — unless the sender is itself overloaded (the
+            # reference's !OVERLOADED/UNDER_PRESSURE guard, which prevents
+            # mute deadlocks among hot actors). Only senders resident on
+            # this shard can be muted here.
             recv_hot = occ_after[ktc] > overload_occ
+            if pressured is not None:
+                recv_hot = recv_hot | pressured[ktc]
             lsnd = snd - shard_base
             sender_local = (lsnd >= 0) & (lsnd < n)
             sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
@@ -228,6 +231,11 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             return spill, newly_muted, refs, ovf
 
         any_pressure = (nrej > 0) | jnp.any(occ_after > overload_occ)
+        if pressured is not None:
+            # Only when a send actually TARGETS a pressured receiver —
+            # an unrelated actor's long-lived pressure (a stalled socket)
+            # must not make every tick pay the pressure branch.
+            any_pressure = any_pressure | jnp.any(pressured[ktc] & (kt < n))
         spill, newly_muted, new_refs, new_ovf = lax.cond(
             any_pressure, pressure, lambda _: _empty_spill(), operand=None)
         return (buf2, new_tail, spill, newly_muted, new_refs, new_ovf,

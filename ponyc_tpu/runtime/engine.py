@@ -570,7 +570,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             ref_local = (lref >= 0) & (lref < nl)
             mr = jnp.minimum(jnp.maximum(lref, 0), nl - 1)
             local_ok = (has & ref_local & (occ0[mr] <= opts.unmute_occ)
-                        & (dspill_pending[mr] == 0))
+                        & (dspill_pending[mr] == 0)
+                        & ~st.pressured[mr])
             # Remote muting ref: release once this shard's route-spill
             # drained (the local evidence of congestion is gone;
             # receiver-side pressure will re-mute via routing if it
@@ -581,7 +582,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             # Overflowed ref sets (more distinct muters than slots) defer
             # to a shard-wide quiet condition — conservative, never early.
             shard_quiet = (jnp.max(occ0) <= opts.unmute_occ) \
-                & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0)
+                & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0) \
+                & ~jnp.any(st.pressured)
             release = st.muted & all_ok & (~st.mute_ovf | shard_quiet)
             return (st.muted & ~release,
                     jnp.where(release[None, :], -1, refs),
@@ -798,7 +800,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                       overload_occ=opts.overload_occ, shard_base=base,
                       mute_slots=opts.mute_slots,
                       level=lvl_all, n_levels=n_levels,
-                      plan=(st.plan_key, st.plan_perm, st.plan_bounds))
+                      plan=(st.plan_key, st.plan_perm, st.plan_bounds),
+                      pressured=st.pressured)
 
         # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
         # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
@@ -806,6 +809,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         # clear, and the row becomes reclaimable by a later spawn.
         new_tail = res.tail
         pinned = st.pinned
+        pressured = st.pressured
         # Int-coded error residue (≙ pony_error_int/code, fork): latest
         # nonzero code per actor + a counter; zero-cost for cohorts whose
         # behaviours never call ctx.error_int (gated at trace).
@@ -833,6 +837,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             mute_refs = mute_refs.at[:, rows].set(-1, mode="drop")
             mute_ovf = mute_ovf.at[rows].set(False, mode="drop")
             pinned = pinned.at[rows].set(False, mode="drop")
+            pressured = pressured.at[rows].set(False, mode="drop")
             n_destroyed = n_destroyed + jnp.sum(dstr.astype(jnp.int32))
 
         # --- 5. mute bookkeeping (≙ ponyint_mute_actor + mutemap insert,
@@ -981,7 +986,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         st2 = RtState(
             buf=res.buf, head=new_head, tail=new_tail,
             alive=alive, muted=muted2, mute_refs=mute_refs2,
-            mute_ovf=mute_ovf2, pinned=pinned,
+            mute_ovf=mute_ovf2, pinned=pinned, pressured=pressured,
             dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
             dspill_words=res.spill.words,
             dspill_count=vec(res.spill_count),

@@ -202,6 +202,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             mute_refs=jnp.where(dead[None, :], -1, st.mute_refs),
             mute_ovf=st.mute_ovf & ~dead,
             pinned=st.pinned & ~dead,
+            pressured=st.pressured & ~dead,
             dspill_tgt=st.dspill_tgt, dspill_sender=st.dspill_sender,
             dspill_words=st.dspill_words, dspill_count=st.dspill_count,
             rspill_tgt=st.rspill_tgt, rspill_sender=st.rspill_sender,

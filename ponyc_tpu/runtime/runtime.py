@@ -43,6 +43,21 @@ class SpillOverflowError(RuntimeError):
     spill_cap, or let backpressure mute faster (lower overload_threshold)."""
 
 
+class AmbientAuth:
+    """Root authority (≙ env.root: AmbientAuth). Obtained only from
+    Runtime.ambient_auth(); narrower capability tokens check for it.
+    The sentinel token (same pattern as files.FilesAuth) makes direct
+    construction impossible, so holding `rt` alone does not mint it."""
+
+    _token = object()
+
+    def __init__(self, rt, token=None):
+        if token is not AmbientAuth._token:
+            raise PermissionError(
+                "obtain AmbientAuth via rt.ambient_auth(), not directly")
+        self._rt = rt
+
+
 class SpawnCapacityError(RuntimeError):
     """A device-side ctx.spawn() wanted a slot but its cohort window had
     none free — raise the target cohort's declared capacity (or let GC /
@@ -318,23 +333,39 @@ class Runtime:
 
     # ---- GC pinning (≙ ORCA's external rc: an actor is born with one
     # reference owned by its creator, actor.c:688-734) ----
+    def _set_flag_column(self, column: str, ids, value: bool) -> None:
+        """Set a per-actor bool flag column host-side. Flag flips never
+        affect slot freedom, so the spawn freelist cache survives."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        fkey = self._freelist_key
+        col = getattr(self.state, column)
+        self.state = self._replace(**{column: col.at[ids].set(value)})
+        self._freelist_key = fkey
+
     def release(self, ids) -> None:
         """Drop the host's reference(s): the actors become collectable as
         soon as they are unreachable and message-quiet (gc.py)."""
-        ids = np.asarray(ids, np.int32).reshape(-1)
-        fkey = self._freelist_key
-        self.state = self._replace(
-            pinned=self.state.pinned.at[ids].set(False))
-        self._freelist_key = fkey   # pinning doesn't affect slot freedom
+        self._set_flag_column("pinned", ids, False)
         self._ever_released = True
 
     def pin(self, ids) -> None:
         """(Re-)pin actors as host-held GC roots."""
-        ids = np.asarray(ids, np.int32).reshape(-1)
-        fkey = self._freelist_key
-        self.state = self._replace(
-            pinned=self.state.pinned.at[ids].set(True))
-        self._freelist_key = fkey   # pinning doesn't affect slot freedom
+        self._set_flag_column("pinned", ids, True)
+
+    def apply_backpressure(self, ids) -> None:
+        """Mark actors UNDER_PRESSURE (≙ pony_apply_backpressure,
+        src/libponyrt/actor/actor.c:1137-1162): senders to these actors
+        mute on send until release_backpressure(), regardless of mailbox
+        occupancy — the hook for pressure the runtime cannot see (a
+        stalled socket, a full external queue). stdlib/backpressure.py
+        wraps this with the reference package's auth-token surface."""
+        self._set_flag_column("pressured", ids, True)
+
+    def release_backpressure(self, ids) -> None:
+        """Clear UNDER_PRESSURE (≙ pony_release_backpressure); muted
+        senders release on the next unmute pass once the receiver is
+        also under the occupancy threshold."""
+        self._set_flag_column("pressured", ids, False)
 
     def gc(self) -> int:
         """Run one collection: trace reachability from the roots, free
@@ -615,6 +646,14 @@ class Runtime:
         the Main actor; see files.py)."""
         from ..files import FilesAuth
         return FilesAuth(FilesAuth._token)
+
+    def ambient_auth(self) -> "AmbientAuth":
+        """The root authority object (≙ env.root: AmbientAuth,
+        packages/builtin/ambient_auth.pony). Narrower tokens —
+        stdlib.backpressure.ApplyReleaseBackpressureAuth,
+        stdlib.signals auth, capsicum rights — derive from it so a
+        library can be handed only the power it needs."""
+        return AmbientAuth(self, AmbientAuth._token)
 
     # ---- host-cohort dispatch (≙ main-thread scheduler path; on a mesh,
     # each shard's host-row tail range is gathered and drained here — the

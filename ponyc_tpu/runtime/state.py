@@ -106,6 +106,11 @@ class RtState:
     #                              the shard is globally quiet
     pinned: jnp.ndarray       # [N] bool — host holds a ref (GC root,
     #                              ≙ ORCA external rc; see runtime/gc.py)
+    pressured: jnp.ndarray    # [N] bool — ≙ FLAG_UNDER_PRESSURE
+    #                              (pony_apply_backpressure,
+    #                              actor.c:1137-1162): the actor declared
+    #                              itself under external pressure; its
+    #                              senders mute on send until released
 
     # Receiver-side overflow spill (local-row targets).
     dspill_tgt: jnp.ndarray    # [P*S] int32 local row, -1 = empty slot
@@ -197,6 +202,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         mute_refs=jnp.full((opts.mute_slots, n), -1, i32),
         mute_ovf=jnp.zeros((n,), jnp.bool_),
         pinned=jnp.zeros((n,), jnp.bool_),
+        pressured=jnp.zeros((n,), jnp.bool_),
         dspill_tgt=jnp.full((s,), -1, i32),
         dspill_sender=jnp.full((s,), -1, i32),
         dspill_words=jnp.zeros((w1, s), i32),

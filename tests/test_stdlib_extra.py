@@ -1,0 +1,223 @@
+"""Stdlib breadth round 2: backpressure, signals, bureaucracy, debug,
+assert, capsicum (≙ packages/{backpressure,signals,bureaucracy,debug,
+assert,capsicum}; SURVEY.md §2.3)."""
+
+import io
+import os
+import signal as _os_signal
+import time
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.errors import PonyError
+from ponyc_tpu.stdlib import backpressure as bp
+from ponyc_tpu.stdlib import bureaucracy, capsicum, signals
+from ponyc_tpu.stdlib.assertion import Assert, Fact
+from ponyc_tpu.stdlib.debug import Debug
+
+
+# ---------- backpressure (≙ pony_apply/release_backpressure) ----------
+
+@actor
+class Sink:
+    total: I32
+
+    BATCH = 4
+
+    @behaviour
+    def consume(self, st, v: I32):
+        return {**st, "total": st["total"] + v}
+
+
+@actor
+class Producer:
+    sink: Ref
+    left: I32
+
+    MAX_SENDS = 2
+
+    @behaviour
+    def produce(self, st, n: I32):
+        self.send(st["sink"], Sink.consume, 1, when=n > 0)
+        self.send(self.actor_id, Producer.produce, n - 1, when=n > 0)
+        return {**st, "left": n - 1}
+
+
+def _bp_build(items=64):
+    opts = RuntimeOptions(mailbox_cap=8, batch=2, msg_words=1,
+                          max_sends=2, spill_cap=128, inject_slots=8)
+    rt = Runtime(opts)
+    rt.declare(Producer, 1).declare(Sink, 1)
+    rt.start()
+    sink = rt.spawn(Sink)
+    prod = rt.spawn(Producer, sink=sink)
+    rt.send(prod, Producer.produce, items)
+    return rt, prod, sink
+
+
+def test_apply_backpressure_mutes_sender_and_release_recovers():
+    rt, prod, sink = _bp_build()
+    inj = rt._drain_inject()
+    st, aux = rt._step(rt.state, *inj)
+    inj = rt._empty_inject
+    for _ in range(3):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    assert not bool(np.asarray(st.muted)[prod]), "no pressure yet"
+
+    auth = bp.ApplyReleaseBackpressureAuth(rt.ambient_auth())
+    bp.apply(auth, sink)
+    st = rt.state
+    for _ in range(3):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    occ = int(np.asarray(st.tail - st.head)[sink])
+    assert bool(np.asarray(st.muted)[prod]), \
+        "sender must mute on send to a pressured receiver"
+    assert occ <= rt.opts.overload_occ, \
+        "mute was pressure-driven, not occupancy-driven"
+
+    bp.release(auth, sink)
+    st = rt.state
+    for _ in range(3):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    assert not bool(np.asarray(st.muted)[prod]), "release must unmute"
+    assert rt.run() == 0
+    assert rt.state_of(sink)["total"] == 64
+
+
+def test_backpressure_auth_requires_ambient():
+    rt, _, _ = _bp_build(items=1)
+    with pytest.raises(TypeError):
+        bp.ApplyReleaseBackpressureAuth(object())
+    with pytest.raises(TypeError):
+        bp.apply(object(), 0)
+    rt.run()
+
+
+# ---------- signals (≙ packages/signals SignalHandler) ----------
+
+@actor
+class SigWatcher:
+    HOST = True
+    hits: I32
+
+    @behaviour
+    def on_event(self, st, kind: I32, arg: I32, flags: I32):
+        return {**st, "hits": st["hits"] + 1}
+
+
+def test_signal_handler_delivers_and_disposes():
+    rt = Runtime(RuntimeOptions(mailbox_cap=16, batch=4, max_sends=1,
+                                msg_words=3, spill_cap=64,
+                                inject_slots=32))
+    rt.declare(SigWatcher, 1).start()
+    w = rt.spawn(SigWatcher)
+    # Park the prior disposition at ignore: dispose() restores it
+    # (≙ _dispose restoring the event), making the post-dispose raise
+    # below a safe no-op instead of the terminating default action.
+    prev = _os_signal.signal(_os_signal.SIGUSR1, _os_signal.SIG_IGN)
+    h = signals.SignalHandler(rt, w, SigWatcher.on_event,
+                              signals.Sig.usr1())
+    h.raise_()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        rt.run(max_steps=50)
+        if rt.state_of(w)["hits"] >= 1:
+            break
+        time.sleep(0.02)
+    assert rt.state_of(w)["hits"] >= 1
+    h.dispose()
+    hits = rt.state_of(w)["hits"]
+    os.kill(os.getpid(), _os_signal.SIGUSR1)   # ignored: disposition
+    time.sleep(0.05)                           # restored to SIG_IGN
+    rt.run(max_steps=50)
+    assert rt.state_of(w)["hits"] == hits
+    _os_signal.signal(_os_signal.SIGUSR1, prev)
+    rt.stop()
+
+
+# ---------- bureaucracy (≙ Custodian + Registrar) ----------
+
+def test_custodian_disposes_objects_and_actors():
+    rt, prod, sink = _bp_build(items=0)
+    closed = []
+
+    class Thing:
+        def dispose(self):
+            closed.append("thing")
+
+    cust = bureaucracy.Custodian()
+    cust.apply(Thing())
+    cust.apply_actor(rt, prod, Producer.produce, 2)
+    cust.dispose()
+    rt.run()
+    assert closed == ["thing"]
+    assert rt.state_of(sink)["total"] == 2      # dispose sent the msg
+    cust.dispose()                               # set cleared: no resend
+    rt.run()
+    assert rt.state_of(sink)["total"] == 2
+
+
+def test_registrar_lookup_fulfils_and_rejects():
+    reg = bureaucracy.Registrar()
+    obj = object()
+    reg.update("db", obj)
+    got = []
+    reg.apply("db").next(got.append)
+    assert got == [obj]
+    rejected = []
+    reg.apply("absent").next(got.append, lambda _r: rejected.append(True))
+    assert rejected == [True]
+    reg.remove("db", object())        # wrong value: keeps mapping
+    reg.apply("db").next(got.append)
+    assert got == [obj, obj]
+    reg.remove("db", obj)             # right value: removes
+    reg.apply("db").next(got.append, lambda _r: rejected.append(True))
+    assert rejected == [True, True]
+
+
+# ---------- debug / assert (≙ packages/debug, packages/assert) ----------
+
+def test_debug_prints_when_enabled(monkeypatch):
+    monkeypatch.setenv("PONY_TPU_DEBUG", "1")
+    buf = io.StringIO()
+    Debug(["a", "b"], sep="/", stream=buf)
+    assert buf.getvalue() == "a/b\n"
+    monkeypatch.setenv("PONY_TPU_DEBUG", "0")
+    buf2 = io.StringIO()
+    Debug("hidden", stream=buf2)
+    assert buf2.getvalue() == ""
+
+
+def test_fact_raises_pony_error_and_assert_follows_debug(monkeypatch):
+    Fact(True)
+    with pytest.raises(PonyError):
+        Fact(False, "nope")
+    monkeypatch.setenv("PONY_TPU_DEBUG", "0")
+    Assert(False, "ignored when debug off")
+    monkeypatch.setenv("PONY_TPU_DEBUG", "1")
+    with pytest.raises(PonyError):
+        Assert(False, "caught when debug on")
+
+
+# ---------- capsicum (≙ packages/capsicum rights algebra) ----------
+
+def test_cap_rights_algebra():
+    r = capsicum.CapRights.from_caps({"read", "seek"})
+    assert r.contains(capsicum.CapRights().set(capsicum.Cap.read()))
+    assert r.contains(capsicum.CapRights().set(capsicum.Cap.mmap()))
+    assert not r.contains(capsicum.CapRights().set(capsicum.Cap.write()))
+    r.set(capsicum.Cap.write())
+    assert r.contains(capsicum.CapRights().set(capsicum.Cap.write()))
+    other = capsicum.CapRights().set(capsicum.Cap.write())
+    r.remove(other)
+    assert not r.contains(other)
+    merged = capsicum.CapRights().merge(r)
+    assert merged.contains(r) and r.contains(merged)
+    r.clear()
+    assert capsicum.CapRights().contains(r)
+    assert r.limit(0) is True
