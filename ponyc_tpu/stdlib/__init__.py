@@ -29,24 +29,31 @@ The reference ships ~31 Pony packages. Their capabilities map here as:
                        idiom replacing packages/random's splittable
                        xoroshiro)
   logger             → stdlib.logger (severity-gated, host-side)
-  backpressure       → Runtime mute/unmute machinery (automatic) +
-                       queue_depth introspection
+  backpressure       → stdlib.backpressure (programmatic apply/release
+                       with ApplyReleaseBackpressureAuth) on top of the
+                       automatic mute/unmute machinery
   serialise          → ponyc_tpu.serialise
   ponytest           → ponyc_tpu.testing
   ponybench          → ponyc_tpu.benching
-  signals            → bridge.signal / bridge.sigterm_dump
+  signals            → stdlib.signals (SignalHandler/Sig) over
+                       bridge.signal / bridge.sigterm_dump
   options            → config.strip_runtime_flags (runtime flags) +
                        stdlib.cli (application flags)
-  bureaucracy        → stdlib.promises.Custodian
-  capsicum           → files.FilesAuth capability chain
-  debug              → stdlib.logger + analysis SIGTERM dumps
-  assert             → ponyc_tpu.testing asserts (host) +
-                       config.debug_checks invariants (device)
+  bureaucracy        → stdlib.bureaucracy (Custodian incl. actor
+                       dispose sends, Registrar with promise lookup)
+  capsicum           → stdlib.capsicum (Cap/CapRights algebra; limit()
+                       no-ops on Linux as on non-FreeBSD Pony) +
+                       files.FilesAuth capability chain
+  debug              → stdlib.debug (Debug.out/err, compiled away
+                       unless debug-configured) + analysis dumps
+  assert             → stdlib.assertion (Assert/Fact raising PonyError)
+                       + config.debug_checks invariants (device)
   builtin_test,
   stdlib/_test       → tests/ (the aggregated suite IS the stdlib test
                        binary; conftest runs every package's tests)
 """
 
-from . import (buffered, cli, collections, encode, format, ini,  # noqa
+from . import (assertion, backpressure, buffered, bureaucracy,  # noqa
+               capsicum, cli, collections, debug, encode, format, ini,
                itertools, json, logger, math, persistent, promises,
-               random, strings, term, timers)  # noqa: F401
+               random, signals, strings, term, timers)  # noqa: F401
