@@ -1,0 +1,125 @@
+"""mandelbrot — port of the reference example `examples/mandelbrot/
+mandelbrot.pony` (Worker actors compute 8-pixel groups of the escape-time
+fractal; the compute-dense F32 workload).
+
+The reference's Worker iterates z := z² + c over groups of 8 pixels,
+clearing bits of a byte as pixels escape, and pushes bytes into a PBM
+row view (mandelbrot.pony:5-66). TPU shape: one Worker actor per
+8-pixel group; `compute` receives the group's 8 real coordinates as a
+VecF32[8] payload and the shared imaginary coordinate, runs the escape
+iteration as a `lax.fori_loop` over [8, lanes] planes (all groups of
+the cohort iterate together — the whole image advances per tick), and
+stores the finished bitmap byte in actor state. The host assembles the
+PBM from the SoA byte column in one bulk read — the TPU-idiomatic
+"collect" (a column gather instead of W*H/8 host messages).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import lax
+
+from .. import F32, I32, Runtime, RuntimeOptions, VecF32, actor, behaviour
+
+ITERATIONS = 64          # static trace bound (≙ --iterations, default 50)
+LIMIT_SQ = 4.0           # escape when |z|² > limit² (≙ limit 4.0)
+
+
+@actor
+class Worker:
+    byte: I32            # finished 8-pixel bitmap byte (MSB = leftmost)
+    done: I32
+
+    MAX_SENDS = 0
+    BATCH = 1
+
+    @behaviour
+    def compute(self, st, cr: VecF32[8], ci: F32):
+        # cr is a planar [8, lanes] block (pack._VecSpec); every group of
+        # the cohort iterates in lockstep on the VPU.
+        def body(_i, carry):
+            zr, zi, alive = carry
+            zr2, zi2 = zr * zr, zi * zi
+            nzr = (zr2 - zi2) + cr
+            nzi = (2.0 * zr * zi) + ci
+            alive = alive & ((zr2 + zi2) <= LIMIT_SQ)
+            return nzr, nzi, alive
+
+        zr0 = cr
+        zi0 = cr * 0.0 + ci
+        alive0 = (zr0 * 0.0) < 1.0            # all True, [8, lanes]
+        _, _, alive = lax.fori_loop(0, ITERATIONS, body,
+                                    (zr0, zi0, alive0))
+        weights = (2 ** np.arange(7, -1, -1)).astype(np.int32)
+        byte = (alive.astype("int32")
+                * weights.reshape((8,) + (1,) * (alive.ndim - 1))).sum(0)
+        return {**st, "byte": byte, "done": 1}
+
+
+def build(width: int = 64, height: int = 64,
+          opts: RuntimeOptions | None = None):
+    """One Worker per 8-pixel group, row-major (width must be a multiple
+    of 8 — the reference has the same constraint via its byte packing)."""
+    if width % 8:
+        raise ValueError("width must be a multiple of 8")
+    groups = (width // 8) * height
+    opts = opts or RuntimeOptions(mailbox_cap=4, batch=1, max_sends=0,
+                                  msg_words=9, spill_cap=64,
+                                  inject_slots=64)
+    rt = Runtime(opts)
+    rt.declare(Worker, groups)
+    rt.start()
+    ids = rt.spawn_many(Worker, groups)
+    return rt, ids
+
+
+def render(width: int = 64, height: int = 64,
+           opts: RuntimeOptions | None = None) -> np.ndarray:
+    """Compute the full image; returns the [height, width//8] byte grid
+    (bit set = pixel in the set, as in the reference's PBM bitmap)."""
+    rt, ids = build(width, height, opts)
+    gw = width // 8
+    # ≙ Main seeding one Worker message per row-band: coordinates ride
+    # as message payloads, computed host-side exactly like the
+    # reference's precomputed real/imaginary arrays (mandelbrot.pony
+    # create()).
+    xs = np.arange(width, dtype=np.float32)
+    ys = np.arange(height, dtype=np.float32)
+    # ≙ the reference's coordinate arrays (mandelbrot.pony:147-155):
+    # real[j] = (2/width)*j - 1.5, imaginary[j] = (2/width)*j - 1.0
+    # (the reference renders square images; we use 2/height for rows).
+    real = (2.0 / width) * xs - 1.5
+    imag = (2.0 / height) * ys - 1.0
+    cr_cols = real.reshape(gw, 8)               # [gw, 8]
+    cr = np.tile(cr_cols, (height, 1))          # [groups, 8] row-major
+    ci = np.repeat(imag, gw)                    # [groups]
+    rt.bulk_send(ids, Worker.compute, cr, ci)
+    rt.run(max_steps=200)
+    st = rt.cohort_state(Worker)
+    assert int(st["done"].sum()) == len(ids), "not all groups computed"
+    return st["byte"].astype(np.uint8).reshape(height, gw)
+
+
+def reference_bytes(width: int, height: int) -> np.ndarray:
+    """NumPy oracle with identical iteration/limit semantics."""
+    xs = np.arange(width, dtype=np.float32)
+    ys = np.arange(height, dtype=np.float32)
+    real = (2.0 / width) * xs - 1.5
+    imag = (2.0 / height) * ys - 1.0
+    c = real[None, :] + 1j * imag[:, None]
+    z = c.astype(np.complex64)
+    alive = np.ones(c.shape, bool)
+    for _ in range(ITERATIONS):
+        alive &= (z.real * z.real + z.imag * z.imag) <= LIMIT_SQ
+        z = np.where(alive, z * z + c, z)
+    bits = alive.reshape(height, width // 8, 8)
+    weights = (2 ** np.arange(7, -1, -1)).astype(np.int32)
+    return (bits * weights).sum(-1).astype(np.uint8)
+
+
+def write_pbm(path: str, bytes_grid: np.ndarray, width: int) -> None:
+    """P4 PBM writer (≙ the reference writing the bitmap via files)."""
+    height = bytes_grid.shape[0]
+    with open(path, "wb") as f:
+        f.write(b"P4\n%d %d\n" % (width, height))
+        f.write(bytes_grid.tobytes())

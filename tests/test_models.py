@@ -97,3 +97,15 @@ def test_ubench_multi_ping_sustains_n_times_pings():
     assert rt.counter("n_processed") == 6 * n * p
     assert not bool(aux.spill_overflow)
     assert not bool(aux.n_muted_now)
+
+
+def test_mandelbrot_matches_numpy_oracle():
+    """Escape-time bytes from the Worker cohort equal the NumPy oracle
+    (≙ examples/mandelbrot computing PBM bitmap bytes in Worker actors)."""
+    from ponyc_tpu.models import mandelbrot
+    w = h = 32
+    got = mandelbrot.render(w, h)
+    want = mandelbrot.reference_bytes(w, h)
+    assert got.shape == want.shape == (h, w // 8)
+    assert (got == want).all()
+    assert 0 < int(want.sum()), "image must not be empty"
