@@ -95,6 +95,12 @@ class RuntimeOptions:
     #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
     #   XLA select-chain; interpret-mode on CPU. Off until measured
     #   faster on the real chip.
+    delivery: str = "plan"         # delivery formulation (delivery.py):
+    #   "plan"   — cached stable-sort plan + permutation gathers (skips
+    #              the sort when traffic shape repeats);
+    #   "cosort" — one stable multi-operand lax.sort per tick that moves
+    #              the payload with the key (no plan, no gathers; wins
+    #              where arbitrary lane gathers lower poorly).
     debug_checks: bool = False     # run Runtime.check_invariants() at
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)
@@ -114,6 +120,8 @@ class RuntimeOptions:
             raise ValueError("msg_words must be >= 1")
         if self.batch < 1:
             raise ValueError("batch must be >= 1")
+        if self.delivery not in ("plan", "cosort"):
+            raise ValueError("delivery must be 'plan' or 'cosort'")
 
     @property
     def overload_occ(self) -> int:

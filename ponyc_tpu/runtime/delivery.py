@@ -96,7 +96,8 @@ def empty_mute_slots(n: int, k: int):
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
             shard_base, mute_slots: int = 4, level=None, n_levels: int = 1,
-            plan=None, pressured=None) -> DeliveryResult:
+            plan=None, pressured=None, cosort: bool = False
+            ) -> DeliveryResult:
     """`level` ([E] int32, 0 = most urgent) folds the fork's actor
     *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
     inject) into the one sort: the composite key (target, level, arrival)
@@ -136,14 +137,32 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     # actually changes shape. ≙ the reference's O(1) pointer-based
     # messageq push (messageq.c:102-160): its "plan" is the receiver
     # pointer each sender holds; ours is the sort amortised across ticks.
+    def _bounds(sorted_key):
+        """Per-target segment bounds over an already-sorted key vector
+        (shared by both delivery formulations so the key/level encoding
+        lives once)."""
+        return jnp.searchsorted(
+            sorted_key, jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
+            side="left").astype(jnp.int32)
+
     def _compute_plan(k):
         p_ = stable_sort_by(k)
-        b_ = jnp.searchsorted(
-            k[p_], jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
-            side="left").astype(jnp.int32)
-        return p_, b_
+        return p_, _bounds(k[p_])
 
-    if plan is None:
+    w1 = words.shape[0]
+    if cosort:
+        # Alternative formulation (opts.delivery == "cosort"): ONE stable
+        # multi-operand sort carries the payload words WITH the key — no
+        # cached plan, no permutation gathers afterwards. On hardware
+        # where arbitrary lane gathers lower poorly this trades the
+        # (plan-cached sort skip + two gathers) for a single native sort
+        # per tick. Same FIFO guarantee: lax.sort is_stable preserves
+        # arrival order within a (target, level) segment. The sort runs
+        # inside the with_msgs cond below (idle ticks stay free); the
+        # returned plan fields are placeholders cosort never reads.
+        perm = jnp.arange(e, dtype=jnp.int32)
+        bounds = jnp.zeros((n + 1,), jnp.int32)
+    elif plan is None:
         perm, bounds = _compute_plan(key)
     else:
         plan_key, plan_perm, plan_bounds = plan
@@ -152,8 +171,6 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             lambda _: (plan_perm, plan_bounds),
             lambda _: _compute_plan(key),
             operand=None)
-
-    w1 = words.shape[0]
 
     def _empty_spill():
         refs, ovf = empty_mute_slots(n, mute_slots)
@@ -167,11 +184,21 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     # no mailbox memory at all (≙ the fork's idle-cost fix is the reason
     # it exists, README.md:8-10 — a waiting scheduler must cost ~nothing).
     def with_msgs(_):
-        kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
-        wds = words[:, perm]                     # [w1, E] sorted
+        if cosort:
+            ops = lax.sort((key, tgt, sender) + tuple(words),
+                           num_keys=1, is_stable=True)
+            key_s, tgt_s, snd_s = ops[0], ops[1], ops[2]
+            wds = jnp.stack(ops[3:])
+            seg_bounds = _bounds(key_s)
+            kt = jnp.where(key_s < n * n_levels, tgt_s, n).astype(jnp.int32)
+        else:
+            snd_s = None
+            seg_bounds = bounds
+            kt = jnp.where(valid, tgt, n).astype(jnp.int32)[perm]
+            wds = words[:, perm]                 # [w1, E] sorted
         ktc = jnp.minimum(kt, n - 1)
-        seg_start = bounds[:-1]                  # [n]
-        cnt = bounds[1:] - seg_start             # [n] msgs per target
+        seg_start = seg_bounds[:-1]              # [n]
+        cnt = seg_bounds[1:] - seg_start         # [n] msgs per target
         occ = tail - head
         space = jnp.maximum(c - occ, 0)
         acc = jnp.minimum(cnt, space)            # accepted per target
@@ -201,7 +228,7 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             ok = kt < n
             rej = ok & (rank >= acc[ktc])
             perm2, vspill, _ = compact_mask(rej, spill_cap)
-            snd = sender[perm]
+            snd = snd_s if cosort else sender[perm]
             spill = Entries(
                 tgt=jnp.where(vspill, kt[perm2], -1),
                 sender=jnp.where(vspill, snd[perm2], -1),

@@ -801,7 +801,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                       mute_slots=opts.mute_slots,
                       level=lvl_all, n_levels=n_levels,
                       plan=(st.plan_key, st.plan_perm, st.plan_bounds),
-                      pressured=st.pressured)
+                      pressured=st.pressured,
+                      cosort=(opts.delivery == "cosort"))
 
         # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
         # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
