@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Native host-runtime microbenchmarks (≙ `make benchmark` over the
+reference's benchmark/libponyrt suite). Prints one row per metric."""
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from ponyc_tpu import native  # noqa: E402
+
+res = native.microbench(scale=float(sys.argv[1]) if len(sys.argv) > 1
+                        else 1.0)
+for k, v in res.items():
+    print(f"{k:28s} {v:10.1f} ns/op")
+print(json.dumps({k: round(v, 1) for k, v in res.items()}))

@@ -81,6 +81,16 @@ def lib() -> ctypes.CDLL:
         l.ponyx_asio_timer.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
                                        c.c_int32, c.c_int32, c.c_int32,
                                        c.c_int32]
+        l.ponyx_bench_pool.restype = c.c_double
+        l.ponyx_bench_pool.argtypes = [c.c_uint64, c.c_uint64]
+        l.ponyx_bench_pool_burst.restype = c.c_double
+        l.ponyx_bench_pool_burst.argtypes = [c.c_uint64, c.c_uint64,
+                                             c.c_uint64]
+        l.ponyx_bench_mpscq.restype = c.c_double
+        l.ponyx_bench_mpscq.argtypes = [c.c_uint64, c.c_uint64]
+        l.ponyx_bench_mpscq_mt.restype = c.c_double
+        l.ponyx_bench_mpscq_mt.argtypes = [c.c_uint64, c.c_uint64,
+                                           c.c_uint64]
         l.ponyx_asio_signal.restype = c.c_int32
         l.ponyx_asio_signal.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
                                         c.c_int32, c.c_int32]
@@ -535,3 +545,20 @@ class AsioLoop:
         if self._h:
             self._l.ponyx_asio_destroy(self._h)
             self._h = None
+
+
+def microbench(scale: float = 1.0) -> dict:
+    """Native-runtime microbenchmarks, timed entirely in C++ (≙ the
+    reference's Google-Benchmark suite over pool/queues,
+    benchmark/libponyrt/mem/pool.cc, benchmark/README.md). Returns
+    {name: ns_per_op}."""
+    l = lib()
+    it = max(1, int(200_000 * scale))
+    return {
+        "pool_alloc_free_64B_ns": l.ponyx_bench_pool(it, 64),
+        "pool_alloc_free_4KB_ns": l.ponyx_bench_pool(it, 4096),
+        "pool_burst32_64B_ns": l.ponyx_bench_pool_burst(
+            max(1, it // 32), 64, 32),
+        "mpscq_push_pop_4w_ns": l.ponyx_bench_mpscq(it, 4),
+        "mpscq_mt_4prod_4w_ns": l.ponyx_bench_mpscq_mt(it, 4, 4),
+    }

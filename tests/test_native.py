@@ -252,3 +252,16 @@ def test_udp_multicast_and_broadcast_options():
     with pytest.raises(OSError):
         S.multicast_join(fd, "not-an-address")
     S.close(fd)
+
+
+def test_native_microbench_sane():
+    """The in-C++ microbench suite (≙ benchmark/libponyrt) runs and
+    returns plausible steady-state costs (pool hit path and MPSC
+    round-trip are tens of ns, never µs-scale)."""
+    from ponyc_tpu import native
+    res = native.microbench(scale=0.05)
+    assert set(res) == {"pool_alloc_free_64B_ns", "pool_alloc_free_4KB_ns",
+                        "pool_burst32_64B_ns", "mpscq_push_pop_4w_ns",
+                        "mpscq_mt_4prod_4w_ns"}
+    for k, v in res.items():
+        assert 0.5 < v < 100_000, (k, v)
