@@ -1,0 +1,159 @@
+"""Round-3 planar-layout profile on TPU: where does the 46 ms tick go?"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from ponyc_tpu.platforms import force_cpu
+if "tpu" not in sys.argv:
+    force_cpu()
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ponyc_tpu import RuntimeOptions
+from ponyc_tpu.models import ubench
+from ponyc_tpu.runtime import engine, delivery
+from ponyc_tpu.ops.segment import stable_sort_by
+
+N = 1 << 20
+CAP = 4
+
+
+def timeit(name, fn, *args, reps=10, jit=True):
+    r = jax.jit(fn) if jit else fn
+    out = r(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = r(*args)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:48s} {dt:8.3f} ms")
+    return out
+
+
+opts = RuntimeOptions(mailbox_cap=CAP, batch=1, max_sends=1, msg_words=1,
+                      spill_cap=1024, inject_slots=8)
+rt, ids = ubench.build(N, opts)
+ubench.seed_all(rt, ids, hops=1 << 30)
+st = rt.state
+print("platform:", jax.devices()[0].platform)
+
+inj = rt._empty_inject
+s2, aux = rt._step(st, *inj)
+jax.block_until_ready(aux)
+t0 = time.time()
+for _ in range(10):
+    s2, aux = rt._step(s2, *inj)
+jax.block_until_ready(aux)
+print(f"{'FULL STEP (unfused)':48s} {(time.time() - t0) / 10 * 1e3:8.3f} ms")
+st = s2
+rt.state = s2
+
+# --- dispatch only (planar)
+ch = rt.program.device_cohorts[0]
+disp = engine._cohort_dispatch(ch, opts, opts.noyield, rt.program)
+idsj = jnp.arange(N, dtype=jnp.int32)
+
+
+def dispatch_only(state):
+    occ = state.tail - state.head
+    runnable = state.alive & ~state.muted
+    return disp(state.type_state[ch.atype.__name__], state.buf,
+                state.head, occ, runnable, idsj, {})
+
+
+out = timeit("dispatch (ring_take+scan+planar branches)", dispatch_only, st)
+
+# --- outbox from dispatch: Entries planar [w1, E]
+ent = out[1]
+tgt, words = jnp.asarray(ent.tgt), jnp.asarray(ent.words)
+E = tgt.shape[0]
+print("outbox E =", E, "words shape:", words.shape)
+
+
+# --- delivery only, with plan cache hit and miss
+def deliver_cached(state, tgt, sender, words):
+    e = delivery.Entries(tgt=tgt, sender=sender, words=words)
+    return delivery.deliver(
+        state.buf, state.head, state.tail, state.alive, e,
+        n_local=N, mailbox_cap=CAP, spill_cap=1024,
+        overload_occ=opts.overload_occ, shard_base=jnp.int32(0),
+        mute_slots=opts.mute_slots,
+        plan=(state.plan_key, state.plan_perm, state.plan_bounds))
+
+
+def deliver_nocache(state, tgt, sender, words):
+    e = delivery.Entries(tgt=tgt, sender=sender, words=words)
+    return delivery.deliver(
+        state.buf, state.head, state.tail, state.alive, e,
+        n_local=N, mailbox_cap=CAP, spill_cap=1024,
+        overload_occ=opts.overload_occ, shard_base=jnp.int32(0),
+        mute_slots=opts.mute_slots, plan=None)
+
+
+sender = jnp.asarray(ent.sender)
+# Compose the full delivery list like the engine: dspill, inject, rspill,
+# then the dispatch outbox (matches state.plan_key length).
+inj_t = jnp.full((opts.inject_slots,), -1, jnp.int32)
+inj_w = jnp.zeros((words.shape[0], opts.inject_slots), jnp.int32)
+tgt_f = jnp.concatenate([st.dspill_tgt, inj_t, st.rspill_tgt, tgt])
+snd_f = jnp.concatenate([st.dspill_sender, inj_t, st.rspill_sender, sender])
+wrd_f = jnp.concatenate([st.dspill_words, inj_w, st.rspill_words, words],
+                        axis=1)
+timeit("delivery (plan cached)", deliver_cached, st, tgt_f, snd_f, wrd_f)
+timeit("delivery (no plan cache)", deliver_nocache, st, tgt_f, snd_f, wrd_f)
+
+# --- sub-pieces
+key = jnp.where(tgt >= 0, tgt, N).astype(jnp.int32)
+timeit("stable_sort_by(key) [E]", stable_sort_by, key)
+perm = stable_sort_by(key)
+timeit("planar payload gather words[:, perm]",
+       lambda w, p: w[:, p], words, perm)
+ks = key[perm]
+bounds = jnp.searchsorted(ks, jnp.arange(N + 1, dtype=jnp.int32),
+                          side="left").astype(jnp.int32)
+seg = bounds[:-1]
+wds = words[:, perm]
+
+
+def plane_rebuild(buf, head, tail):
+    occ = tail - head
+    space = jnp.maximum(CAP - occ, 0)
+    cnt = bounds[1:] - seg
+    acc = jnp.minimum(cnt, space)
+    planes = []
+    for ci in range(CAP):
+        rel = (ci - tail) % CAP
+        wmask = rel < acc
+        src = jnp.minimum(seg + rel, E - 1)
+        planes.append(jnp.where(wmask[None, :],
+                                jnp.take(wds, src, axis=1),
+                                buf[ci]))
+    return jnp.stack(planes)
+
+
+timeit("plane rebuild (CAP planes)", plane_rebuild, st.buf, st.head, st.tail)
+
+# --- ring take chain (dispatch input read)
+def ring_take_all(buf, head):
+    return engine._ring_take(buf, head % CAP)
+
+
+timeit("_ring_take (select chain over cap)", ring_take_all, st.buf, st.head)
+
+# --- key equality (cache validate)
+timeit("plan key compare", lambda a, b: jnp.all(a == b), key, key)
+
+# --- spawn-free pure carriers
+timeit("tail-head etc (occ, runnable)",
+       lambda s: (s.tail - s.head, s.alive & ~s.muted), st)
+
+# --- XLA cost analysis of the full step
+c = jax.jit(rt._step_fn, donate_argnums=()).lower(st, *inj).compile()
+ca = c.cost_analysis()
+if ca:
+    d = ca if isinstance(ca, dict) else ca[0]
+    print("cost analysis: flops=%.3g bytes=%.3g" % (
+        d.get("flops", -1), d.get("bytes accessed", -1)))

@@ -1,0 +1,172 @@
+"""Round-3 bisection profile: measure tick_ms of the fused window with
+individual step components stubbed out (monkeypatched), to localise the
+46 ms. Usage: python _profile9.py tpu <variant>
+
+Variants:
+  full      - unmodified step
+  nodeliver - delivery returns buf/tail unchanged; head also frozen so
+              dispatch stays busy on the same message every tick
+  norebuild - delivery runs sort/gather/bounds but skips the plane rebuild
+  nogather  - delivery skips the payload gather (rebuild reads unsorted)
+  nodisp    - dispatch emits an empty outbox (delivery cond goes idle):
+              measures the fixed per-tick frame
+  nocounts  - counts_by_key (dspill pending histogram) stubbed to zeros
+  pallas    - opts.pallas=True (Pallas mailbox drain kernel)
+  pings4    - 4 in-flight pings per pinger, batch=4 (bench --pings 4)
+  mutes1    - mute_slots=1 (shrinks the [K,N] merge traffic)
+  cap2      - mailbox_cap=2
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from ponyc_tpu.platforms import force_cpu
+if "tpu" not in sys.argv:
+    force_cpu()
+
+VARIANT = ([a for a in sys.argv[1:] if a != "tpu"] or ["full"])[0]
+PINGS = 4 if VARIANT == "pings4" else 1
+
+import jax
+import jax.numpy as jnp
+
+from ponyc_tpu import RuntimeOptions
+from ponyc_tpu.models import ubench
+from ponyc_tpu.runtime import engine, delivery
+
+N = 1 << 20
+CAP = 2 if VARIANT == "cap2" else 4
+MUTE_SLOTS = 1 if VARIANT == "mutes1" else 4
+
+real_deliver = delivery.deliver
+
+
+def deliver_nodeliver(buf, head, tail, alive, entries, **kw):
+    res = real_deliver(buf, head, tail, alive, entries, **kw)
+    return res._replace(buf=buf, tail=tail)
+
+
+def make_deliver_patch(skip_rebuild=False, skip_gather=False):
+    from jax import lax
+    from ponyc_tpu.ops.segment import stable_sort_by
+
+    def deliver(buf, head, tail, alive, entries, *, n_local, mailbox_cap,
+                spill_cap, overload_occ, shard_base, mute_slots=4,
+                level=None, n_levels=1, plan=None):
+        n, c = n_local, mailbox_cap
+        tgt, sender, words = entries
+        e = tgt.shape[0]
+        in_range = (tgt >= 0) & (tgt < n)
+        tgt_c = jnp.minimum(jnp.maximum(tgt, 0), n - 1)
+        to_dead = in_range & ~alive[tgt_c]
+        valid = in_range & ~to_dead
+        if level is None:
+            level = jnp.zeros((e,), jnp.int32)
+            n_levels = 1
+        key = jnp.where(valid, tgt * n_levels + level,
+                        n * n_levels).astype(jnp.int32)
+
+        def _compute_plan(k):
+            p_ = stable_sort_by(k)
+            b_ = jnp.searchsorted(
+                k[p_], jnp.arange(n + 1, dtype=jnp.int32) * n_levels,
+                side="left").astype(jnp.int32)
+            return p_, b_
+
+        if plan is None:
+            perm, bounds = _compute_plan(key)
+        else:
+            plan_key, plan_perm, plan_bounds = plan
+            perm, bounds = lax.cond(
+                jnp.all(key == plan_key),
+                lambda _: (plan_perm, plan_bounds),
+                lambda _: _compute_plan(key), operand=None)
+        w1 = words.shape[0]
+        if skip_gather:
+            wds = words
+        else:
+            wds = words[:, perm]
+        seg_start = bounds[:-1]
+        cnt = bounds[1:] - seg_start
+        occ = tail - head
+        space = jnp.maximum(c - occ, 0)
+        acc = jnp.minimum(cnt, space)
+        new_tail = tail + acc
+        if skip_rebuild:
+            buf2 = buf
+        else:
+            planes = []
+            for ci in range(c):
+                rel = (ci - tail) % c
+                wmask = rel < acc
+                src = jnp.minimum(seg_start + rel, e - 1)
+                planes.append(jnp.where(wmask[None, :],
+                                        jnp.take(wds, src, axis=1),
+                                        buf[ci]))
+            buf2 = jnp.stack(planes)
+        refs, ovf = delivery.empty_mute_slots(n, mute_slots)
+        return delivery.DeliveryResult(
+            buf=buf2, tail=new_tail,
+            spill=delivery.Entries(
+                tgt=jnp.full((spill_cap,), -1, jnp.int32),
+                sender=jnp.full((spill_cap,), -1, jnp.int32),
+                words=jnp.zeros((w1, spill_cap), jnp.int32)),
+            spill_count=jnp.int32(0),
+            spill_overflow=jnp.bool_(False),
+            newly_muted=jnp.zeros((n,), jnp.bool_),
+            new_mute_refs=refs, new_mute_ovf=ovf,
+            n_delivered=jnp.sum(acc), n_rejected=jnp.int32(0),
+            n_deadletter=jnp.sum(to_dead.astype(jnp.int32)),
+            plan_key=key, plan_perm=perm, plan_bounds=bounds)
+    return deliver
+
+
+if VARIANT == "nodeliver":
+    engine.deliver = deliver_nodeliver
+elif VARIANT == "norebuild":
+    engine.deliver = make_deliver_patch(skip_rebuild=True)
+elif VARIANT == "nogather":
+    engine.deliver = make_deliver_patch(skip_gather=True)
+elif VARIANT == "nocounts":
+    engine.counts_by_key = (
+        lambda keys, vals, n: jnp.zeros((n,), jnp.int32))
+elif VARIANT == "nodisp":
+    real_cd = engine._cohort_dispatch
+
+    def patched_cd(cohort, opts, noyield, program):
+        inner = real_cd(cohort, opts, noyield, program)
+
+        def run_cohort(ts, buf_rows, head_rows, occ_rows, runnable_rows,
+                       ids, resv):
+            out = inner(ts, buf_rows, head_rows, occ_rows,
+                        jnp.zeros_like(runnable_rows), ids, resv)
+            return out
+        return run_cohort
+    engine._cohort_dispatch = patched_cd
+
+opts = RuntimeOptions(mailbox_cap=CAP, batch=PINGS, max_sends=1,
+                      msg_words=1, spill_cap=1024, inject_slots=8,
+                      mute_slots=MUTE_SLOTS,
+                      pallas=(VARIANT == "pallas"))
+rt, ids = ubench.build(N, opts, pings=PINGS)
+ubench.seed_all(rt, ids, hops=1 << 30, pings=PINGS)
+print("platform:", jax.devices()[0].platform, "variant:", VARIANT,
+      flush=True)
+
+K = 64
+limit = jnp.int32(K)
+inj = rt._empty_inject
+multi = engine.jit_multi_step(rt.program, opts)
+state = rt.state
+t0 = time.time()
+state, aux, _k = multi(state, *inj, limit)
+jax.block_until_ready(aux)
+print(f"compile+first window: {time.time() - t0:.1f}s", flush=True)
+best = 1e9
+for _ in range(4):
+    t0 = time.time()
+    state, aux, _k = multi(state, *inj, limit)
+    jax.block_until_ready(aux)
+    best = min(best, time.time() - t0)
+print(f"{VARIANT:10s} tick_ms = {best / K * 1e3:.3f}  (ticks/window={int(_k)})",
+      flush=True)

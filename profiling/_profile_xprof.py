@@ -1,0 +1,72 @@
+"""Capture a per-op TPU trace of the ubench fused window via
+jax.profiler, then parse the xplane with xprof/tensorboard-plugin-profile
+and print the op-time table. Usage: python _profile_xprof.py tpu [pings]"""
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from ponyc_tpu.platforms import force_cpu
+if "tpu" not in sys.argv:
+    force_cpu()
+
+PINGS = 4 if "pings" in sys.argv else 1
+
+import jax
+import jax.numpy as jnp
+
+from ponyc_tpu import RuntimeOptions
+from ponyc_tpu.models import ubench
+
+N = 1 << 20
+CAP = 4
+opts = RuntimeOptions(mailbox_cap=CAP, batch=PINGS, max_sends=1,
+                      msg_words=1, spill_cap=1024, inject_slots=8)
+rt, ids = ubench.build(N, opts, pings=PINGS)
+ubench.seed_all(rt, ids, hops=1 << 30, pings=PINGS)
+print("platform:", jax.devices()[0].platform, "pings:", PINGS, flush=True)
+
+K = 16
+limit = jnp.int32(K)
+inj = rt._empty_inject
+state = rt.state
+t0 = time.time()
+state, aux, _k = rt._multi(state, *inj, limit)
+jax.block_until_ready(aux)
+print(f"compile+first window: {time.time() - t0:.1f}s", flush=True)
+
+logdir = "/tmp/xprof_ubench"
+os.system(f"rm -rf {logdir}")
+jax.profiler.start_trace(logdir)
+for _ in range(2):
+    state, aux, _k = rt._multi(state, *inj, limit)
+jax.block_until_ready(aux)
+jax.profiler.stop_trace()
+t0 = time.time()
+state, aux, _k = rt._multi(state, *inj, limit)
+jax.block_until_ready(aux)
+print(f"tick_ms (post-trace window): {(time.time() - t0) / K * 1e3:.3f}",
+      flush=True)
+
+planes = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+print("xplanes:", planes, flush=True)
+if planes:
+    try:
+        from tensorboard_plugin_profile.convert import raw_to_tool_data
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            [planes[0]], "op_profile", {})
+        open("/tmp/xprof_op_profile.json", "wb").write(
+            data if isinstance(data, bytes) else data.encode())
+        print("wrote /tmp/xprof_op_profile.json", flush=True)
+    except Exception as e:
+        print("op_profile failed:", e, flush=True)
+    try:
+        from tensorboard_plugin_profile.convert import raw_to_tool_data
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            [planes[0]], "hlo_stats", {})
+        open("/tmp/xprof_hlo_stats.json", "wb").write(
+            data if isinstance(data, bytes) else data.encode())
+        print("wrote /tmp/xprof_hlo_stats.json", flush=True)
+    except Exception as e:
+        print("hlo_stats failed:", e, flush=True)
