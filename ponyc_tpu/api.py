@@ -41,8 +41,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import (Bool, F32, I32, Ref, VecF32,  # noqa
-                       VecI32)  # re-exported
+from .ops.pack import (Bool, F32, I8, I16, I32, Ref, U8, U16,  # noqa
+                       U32, VecF32, VecI32)  # re-exported
 
 
 class BehaviourDef:
@@ -94,7 +94,18 @@ class ActorTypeMeta(type):
         for key, val in list(ns.get("__annotations__", {}).items()):
             if key.startswith("_") or key.isupper():
                 continue
-            fields[key] = pack.normalize_annotation(val)
+            spec = pack.normalize_annotation(val)
+            if spec in pack._NARROW_JNP:
+                # State columns are i32/f32 only; letting a narrow marker
+                # through would silently give the field signed-i32
+                # semantics while the same marker on a message argument
+                # arrives at its declared width.
+                raise TypeError(
+                    f"{name}.{key}: narrow/unsigned widths "
+                    f"({spec.__name__}) are message-argument types; "
+                    "declare state fields as I32 (or F32) and wrap "
+                    "explicitly in the behaviour")
+            fields[key] = spec
         own = [val for val in ns.values() if isinstance(val, BehaviourDef)]
         cls = super().__new__(mcs, name, bases, ns)
         # Inherited behaviours get a *fresh* BehaviourDef per subclass:

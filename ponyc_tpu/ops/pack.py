@@ -27,6 +27,46 @@ class Bool:
     """Marker annotation: boolean argument."""
 
 
+class U32:
+    """Marker annotation: 32-bit unsigned integer (bit-reinterpreted into
+    the i32 message word; behaviours receive a uint32 array).
+
+    ≙ the reference's builtin numerics breadth (packages/builtin U8..U128,
+    I8..I128): the widths offered here are the ones TPU device compute
+    handles honestly without 64-bit emulation — U32/U16/U8/I16/I8 ride a
+    single i32 word each; 64/128-bit integer types are host-side Python
+    ints (arbitrary precision), a documented divergence."""
+
+
+class I16:
+    """Marker annotation: 16-bit signed integer (wraps to i16 range)."""
+
+
+class U16:
+    """Marker annotation: 16-bit unsigned integer."""
+
+
+class I8:
+    """Marker annotation: 8-bit signed integer (wraps to i8 range)."""
+
+
+class U8:
+    """Marker annotation: 8-bit unsigned integer."""
+
+
+# Single source of truth for the narrow/unsigned single-word specs:
+# marker -> (jnp dtype, numpy dtype name). runtime.py's host pack path
+# derives its numpy map from this.
+_NARROW_JNP = {U32: jnp.uint32, I16: jnp.int16, U16: jnp.uint16,
+               I8: jnp.int8, U8: jnp.uint8}
+
+
+def narrow_np_map():
+    import numpy as _np
+    return {m: _np.dtype(dt.dtype if hasattr(dt, "dtype") else dt).type
+            for m, dt in _NARROW_JNP.items()}
+
+
 class _RefTo:
     """A typed actor-reference annotation: Ref[SomeActor].
 
@@ -148,7 +188,7 @@ class RefTypes:
         return ent[1] if ent is not None else None
 
 
-_MARKERS = (I32, F32, Bool, Ref)
+_MARKERS = (I32, F32, Bool, Ref, U32, I16, U16, I8, U8)
 
 
 def normalize_annotation(ann):
@@ -174,6 +214,16 @@ def normalize_annotation(ann):
         return F32
     if ann in (bool, jnp.bool_, "bool", "Bool"):
         return Bool
+    narrow_alias = {"U32": U32, "u32": U32, jnp.uint32: U32,
+                    "I16": I16, "i16": I16, jnp.int16: I16,
+                    "U16": U16, "u16": U16, jnp.uint16: U16,
+                    "I8": I8, "i8": I8, jnp.int8: I8,
+                    "U8": U8, "u8": U8, jnp.uint8: U8}
+    try:
+        if ann in narrow_alias:
+            return narrow_alias[ann]
+    except TypeError:
+        pass                       # unhashable annotation → fall through
     if ann in ("Ref", "ActorRef"):
         return Ref
     if isinstance(ann, str) and ann.startswith("Ref[") and ann.endswith("]"):
@@ -187,6 +237,16 @@ def pack_arg(ann, value):
         return jnp.asarray(value, jnp.float32).view(jnp.int32)
     if ann is Bool:
         return jnp.asarray(value, jnp.bool_).astype(jnp.int32)
+    if ann in _NARROW_JNP:
+        dt = _NARROW_JNP[ann]
+        # Route through int64 so out-of-range values WRAP to the declared
+        # width (jnp.asarray(value, dt) would raise OverflowError for
+        # out-of-range Python ints under NumPy 2) — same semantics as the
+        # host pack path.
+        v = jnp.asarray(value, jnp.int64).astype(dt)
+        if dt is jnp.uint32:
+            return v.view(jnp.int32)     # bit-reinterpret, value preserved
+        return v.astype(jnp.int32)       # widen (sign/zero extend)
     return jnp.asarray(value, jnp.int32)
 
 
@@ -197,6 +257,11 @@ def unpack_arg(ann, word):
         return word.view(jnp.float32)
     if ann is Bool:
         return word.astype(jnp.bool_)
+    if ann in _NARROW_JNP:
+        dt = _NARROW_JNP[ann]
+        if dt is jnp.uint32:
+            return word.view(jnp.uint32)
+        return word.astype(dt)           # truncate back to declared width
     return word
 
 

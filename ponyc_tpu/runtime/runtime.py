@@ -109,6 +109,16 @@ def _host_pack_args(specs, args, msg_words):
         elif spec is pack.Bool:
             words[off] = np.int32(bool(v))
             off += 1
+        elif spec is pack.U32:
+            words[off] = np.asarray(v, np.int64).astype(
+                np.uint32).view(np.int32)
+            off += 1
+        elif spec in pack._NARROW_JNP:
+            dt = pack.narrow_np_map()[spec]
+            # astype wraps out-of-range values to the declared width
+            # (np scalar constructors would raise instead).
+            words[off] = np.asarray(v, np.int64).astype(dt).astype(np.int32)
+            off += 1
         else:
             words[off] = np.int32(v)
             off += 1
@@ -131,6 +141,10 @@ def _host_unpack_args(specs, words):
             out.append(float(w.view(np.float32)))
         elif spec is pack.Bool:
             out.append(bool(w))
+        elif spec is pack.U32:
+            out.append(int(w.view(np.uint32)))
+        elif spec in pack._NARROW_JNP:
+            out.append(int(w.astype(pack.narrow_np_map()[spec])))
         else:
             out.append(int(w))
     return tuple(out)

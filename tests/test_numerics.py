@@ -1,0 +1,85 @@
+"""Narrow/unsigned behaviour-argument widths (≙ packages/builtin numeric
+breadth U8..U32/I8..I32; 64/128-bit stay host-side Python ints — the
+documented TPU divergence, ops/pack.py U32 docstring)."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (Bool, I8, I16, I32, Ref, Runtime, RuntimeOptions,
+                       U8, U16, U32, actor, behaviour)
+
+
+@actor
+class NumSink:
+    total: I32
+    last_u32_lo: I32      # u32 value mod 2^16 (fits an i32 column)
+
+    @behaviour
+    def take(self, st, a: U32, b: I16, c: U16, d: I8, e: U8, f: Bool):
+        # a arrives as uint32; narrow ints arrive at their declared
+        # widths; compute mixes them into an i32 accumulator.
+        lo = (a % np.uint32(65536)).astype("int32")
+        acc = (lo + b.astype("int32") + c.astype("int32")
+               + d.astype("int32") + e.astype("int32")
+               + f.astype("int32"))
+        return {**st, "total": st["total"] + acc, "last_u32_lo": lo}
+
+
+@actor
+class HostNum:
+    HOST = True
+    got: I32
+
+    @behaviour
+    def take(self, st, a: U32, d: I8):
+        # host behaviours receive plain Python ints at declared widths
+        assert isinstance(a, int) and a >= 0
+        return {**st, "got": a % 1000 + d}
+
+
+def _rt():
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=4, msg_words=6,
+                                max_sends=1, spill_cap=64,
+                                inject_slots=16))
+    rt.declare(NumSink, 2).declare(HostNum, 1).start()
+    return rt
+
+
+def test_device_narrow_widths_roundtrip():
+    rt = _rt()
+    s = rt.spawn(NumSink)
+    # u32 above 2^31; narrow values that wrap
+    rt.send(s, NumSink.take, 3_000_000_007, -5, 65535, -128, 255, True)
+    rt.run()
+    st = rt.state_of(s)
+    lo = 3_000_000_007 % 65536
+    assert st["last_u32_lo"] == lo
+    assert st["total"] == lo - 5 + 65535 - 128 + 255 + 1
+
+
+def test_narrow_wrap_semantics():
+    rt = _rt()
+    s = rt.spawn(NumSink)
+    # out-of-range inputs wrap to their declared width (≙ Pony's
+    # fixed-width integer wrap): 70000 as I16 -> 70000-65536 = 4464
+    rt.send(s, NumSink.take, 2**32 + 7, 70000, 70000, 130, 300, False)
+    rt.run()
+    st = rt.state_of(s)
+    assert st["last_u32_lo"] == 7
+    assert st["total"] == (7 + (70000 - 65536) + (70000 - 65536)
+                           + (130 - 256) + (300 - 256))
+
+
+def test_host_actor_receives_widened_ints():
+    rt = _rt()
+    h = rt.spawn(HostNum)
+    rt.send(h, HostNum.take, 4_000_000_123, -3)
+    rt.run()
+    assert rt.state_of(h)["got"] == 4_000_000_123 % 1000 - 3
+
+
+def test_narrow_marker_rejected_as_field():
+    with pytest.raises(TypeError, match="message-argument types"):
+        @actor
+        class Bad:  # noqa: F811
+            big: U32
