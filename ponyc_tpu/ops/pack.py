@@ -239,11 +239,15 @@ def pack_arg(ann, value):
         return jnp.asarray(value, jnp.bool_).astype(jnp.int32)
     if ann in _NARROW_JNP:
         dt = _NARROW_JNP[ann]
-        # Route through int64 so out-of-range values WRAP to the declared
-        # width (jnp.asarray(value, dt) would raise OverflowError for
-        # out-of-range Python ints under NumPy 2) — same semantics as the
-        # host pack path.
-        v = jnp.asarray(value, jnp.int64).astype(dt)
+        # Out-of-range CONCRETE values must WRAP to the declared width
+        # (jnp.asarray(value, dt) would raise OverflowError under
+        # NumPy 2) — wrap them host-side through int64; traced values are
+        # already i32-width, where astype wraps natively.
+        if not hasattr(value, "aval"):
+            import numpy as _np
+            value = _np.asarray(value, _np.int64).astype(
+                narrow_np_map()[ann])
+        v = jnp.asarray(value).astype(dt)
         if dt is jnp.uint32:
             return v.view(jnp.int32)     # bit-reinterpret, value preserved
         return v.astype(jnp.int32)       # widen (sign/zero extend)
