@@ -174,8 +174,8 @@ class Context:
     __slots__ = ("actor_id", "msg_words", "sends", "exit_flag", "exit_code",
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
                  "spawn_claims", "destroy_called", "error_flag",
-                 "error_code", "error_called", "ref_types", "_spawn_meta",
-                 "sync_inits", "_effected")
+                 "error_code", "error_loc", "error_called", "ref_types",
+                 "_spawn_meta", "sync_inits", "_effected")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None,
                  spawn_meta=None):
@@ -190,6 +190,7 @@ class Context:
         self.destroy_called = False      # trace-time: did destroy() run?
         self.error_flag = jnp.bool_(False)
         self.error_code = jnp.int32(0)
+        self.error_loc = jnp.int32(0)
         self.error_called = False        # trace-time: did error_int() run?
         # {target type name: [n_sites] i32 reserved global ids} for this
         # dispatch; None entries = -1 (no free slot was available).
@@ -379,7 +380,13 @@ class Context:
         observable residue): the latest nonzero code is queryable via
         Runtime.last_error() and surfaces in the analysis dump."""
         self.error_called = True
+        # Trace-time raise site (≙ the fork's __error_loc): the Python
+        # call site interns into a host-side table; the device carries
+        # only the i32 site id.
+        from .errors import caller_loc, register_error_site
+        site = register_error_site(caller_loc())
         w = jnp.asarray(when, jnp.bool_)
         self.error_flag = self.error_flag | w
         self.error_code = jnp.where(w, jnp.asarray(code, jnp.int32),
                                     self.error_code)
+        self.error_loc = jnp.where(w, jnp.int32(site), self.error_loc)

@@ -22,8 +22,24 @@ file/line), surfaced in logs and pony_try results.
 
 from __future__ import annotations
 
+import os
 import traceback
 from typing import Any, Callable, Tuple
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def caller_loc(skip_pkg: bool = True) -> str:
+    """file:line of the nearest stack frame OUTSIDE the ponyc_tpu
+    package (≙ pony_error_loc pointing at user code). Shared by
+    PonyError and Context.error_int so raise-site attribution lives
+    once — helpers like stdlib Fact/Assert and error_int itself never
+    claim the location."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = os.path.abspath(frame.filename)
+        if not skip_pkg or not fn.startswith(_PKG_DIR + os.sep):
+            return f"{frame.filename}:{frame.lineno}"
+    return "?"
 
 
 class PonyError(Exception):
@@ -32,10 +48,9 @@ class PonyError(Exception):
     def __init__(self, code: int = 1, message: str = ""):
         super().__init__(message or f"error {code}")
         self.code = int(code)
-        # ≙ pony_error_loc: the raise site.
-        stack = traceback.extract_stack(limit=3)
-        frame = stack[0] if stack else None
-        self.loc = (f"{frame.filename}:{frame.lineno}" if frame else "?")
+        # ≙ pony_error_loc: the nearest user-code raise site (so Fact/
+        # Assert and other in-package helpers attribute to their caller).
+        self.loc = caller_loc()
 
 
 def pony_try(fn: Callable, *args, **kw) -> Tuple[bool, Any]:
@@ -45,3 +60,29 @@ def pony_try(fn: Callable, *args, **kw) -> Tuple[bool, Any]:
         return True, fn(*args, **kw)
     except PonyError as e:
         return False, e.code
+
+
+# --- device error-site registry (≙ the fork's __error_loc token,
+# DIVERGENCE.md "Retrieve the source location where an error occurred").
+# Each trace-time ctx.error_int() call site registers its Python
+# file:line here once; the device carries only the i32 site id (+1; 0 =
+# no error), and Runtime.last_error_loc() resolves it back to a string —
+# the same "C-string table on the side" performance choice the fork
+# made for __error_loc.
+_device_error_sites: list = ["?"]     # id 0 = no/unknown site
+
+
+def register_error_site(loc: str) -> int:
+    """Intern a trace-time error site, returning its id (>= 1)."""
+    try:
+        return _device_error_sites.index(loc)
+    except ValueError:
+        _device_error_sites.append(loc)
+        return len(_device_error_sites) - 1
+
+
+def error_site(site_id: int) -> str:
+    """Resolve a site id from the last_error_loc column."""
+    if 0 <= site_id < len(_device_error_sites):
+        return _device_error_sites[site_id]
+    return "?"

@@ -61,10 +61,17 @@ _NARROW_JNP = {U32: jnp.uint32, I16: jnp.int16, U16: jnp.uint16,
                I8: jnp.int8, U8: jnp.uint8}
 
 
+_NARROW_NP_CACHE = None
+
+
 def narrow_np_map():
-    import numpy as _np
-    return {m: _np.dtype(dt.dtype if hasattr(dt, "dtype") else dt).type
+    global _NARROW_NP_CACHE
+    if _NARROW_NP_CACHE is None:
+        import numpy as _np
+        _NARROW_NP_CACHE = {
+            m: _np.dtype(dt.dtype if hasattr(dt, "dtype") else dt).type
             for m, dt in _NARROW_JNP.items()}
+    return _NARROW_NP_CACHE
 
 
 class _RefTo:

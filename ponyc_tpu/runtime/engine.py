@@ -202,7 +202,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                 _bcast_lanes(ctx.spawn_fail, b, lanes),
                 _bcast_lanes(ctx.destroy_flag, b, lanes),
                 (_bcast_lanes(ctx.error_flag, b, lanes),
-                 _bcast_lanes(ctx.error_code, jnp.int32, lanes)))
+                 _bcast_lanes(ctx.error_code, jnp.int32, lanes),
+                 _bcast_lanes(ctx.error_loc, jnp.int32, lanes)))
 
     return branch
 
@@ -247,7 +248,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
         e = rows * batch * ms
 
         def scan_body(carry, x):
-            (st, stopped, ef, ec, sfail, dstr, errf, errc, used,
+            (st, stopped, ef, ec, sfail, dstr, errf, errc, errl, used,
              nproc, nbad) = carry
             msg, valid = x                    # msg [w1, rows], valid [rows]
             # Hand one dispatch-worth of spawn reservations to this batch
@@ -277,6 +278,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             ds_n = jnp.zeros((rows,), jnp.bool_)
             erf_n = jnp.zeros((rows,), jnp.bool_)
             erc_n = jnp.zeros((rows,), jnp.int32)
+            erl_n = jnp.zeros((rows,), jnp.int32)
             clm_n = [[jnp.full((rows,), -1, jnp.int32)
                       for _ in range(n)] for _, n in spawn_sites]
             ini_n = []
@@ -293,7 +295,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             for j, br in enumerate(branches):
                 take = (do & in_range & (local == j))
                 (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf, bds,
-                 (berf, berc)) = br(st, msg[1:], ids, resv_k)
+                 (berf, berc, berl)) = br(st, msg[1:], ids, resv_k)
                 for k in st_n:
                     st_n[k] = jnp.where(take, st2[k], st_n[k])
                 for m in range(ms):
@@ -306,6 +308,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 ds_n = jnp.where(take, bds, ds_n)
                 erf_n = jnp.where(take, berf, erf_n)
                 erc_n = jnp.where(take, berc, erc_n)
+                erl_n = jnp.where(take, berl, erl_n)
                 for si, (_, n) in enumerate(spawn_sites):
                     bh, bv = bini[si]
                     hh, vv = ini_n[si]
@@ -338,6 +341,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             return ((st_n, stopped2, new_ef, new_ec, sfail | sf_n,
                      dstr | ds_n, errf | erf_n,
                      jnp.where(erf_n, erc_n, errc),
+                     jnp.where(erf_n, erl_n, errl),
                      used + spawned_here.astype(jnp.int32),
                      nproc + (do & in_range).astype(jnp.int32),
                      nbad + (do & ~in_range).astype(jnp.int32)),
@@ -363,8 +367,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
                       z(jnp.int32), z(jnp.bool_), z(jnp.bool_),
                       z(jnp.bool_), z(jnp.int32), z(jnp.int32),
-                      z(jnp.int32), z(jnp.int32))
-            ((stf, _, ef, ec, sfail, dstr, errf, errc, _used, nproc,
+                      z(jnp.int32), z(jnp.int32), z(jnp.int32))
+            ((stf, _, ef, ec, sfail, dstr, errf, errc, errl, _used, nproc,
               nbad),
              (stgt, swrd, consumed, claims, inits)) = lax.scan(
                 scan_body, carry0, (msgs, valids))
@@ -381,7 +385,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     tuple((h.reshape(-1),
                            {f: v.reshape(-1) for f, v in vals.items()})
                           for h, vals in inits),
-                    jnp.any(sfail), dstr, errf, errc)
+                    jnp.any(sfail), dstr, errf, errc, errl)
 
         def idle_fn(_):
             # ≙ the fork's whole point (README.md:8-10, scaling_sleep): a
@@ -405,13 +409,14 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     jnp.bool_(False),
                     jnp.zeros((rows,), jnp.bool_),
                     jnp.zeros((rows,), jnp.bool_),
+                    jnp.zeros((rows,), jnp.int32),
                     jnp.zeros((rows,), jnp.int32))
 
         busy = jnp.any(runnable_rows & (occ_rows > 0))
         # (cond traces both branches here, so `effects` is fully
         # populated by the time the lines below read it.)
         (stf, out_tgt, out_words, new_head, any_exit, code, nproc, nbad,
-         claims_t, inits_t, sfail, dstr, errf, errc) = lax.cond(
+         claims_t, inits_t, sfail, dstr, errf, errc, errl) = lax.cond(
             busy, busy_fn, idle_fn, operand=None)
         sender = jnp.tile(ids, batch * ms)    # entry (b, m, r): sender=ids[r]
         out = Entries(tgt=out_tgt, sender=sender, words=out_words)
@@ -422,7 +427,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 flat_inits if effects["sync_init"] else None,
                 sfail,
                 dstr if effects["destroy"] else None,
-                (errf, errc) if effects["error"] else None)
+                (errf, errc, errl) if effects["error"] else None)
 
     return run_cohort
 
@@ -815,15 +820,18 @@ def build_step(program: Program, opts: RuntimeOptions):
         # nonzero code per actor + a counter; zero-cost for cohorts whose
         # behaviours never call ctx.error_int (gated at trace).
         last_error = st.last_error
+        last_error_loc = st.last_error_loc
         n_errors = jnp.int32(0)
         for s0, errs in error_rows:
             if errs is None:
                 continue
-            errf, errc = errs
+            errf, errc, errl = errs
             rows = jnp.where(errf, s0 + jnp.arange(errf.shape[0],
                                                    dtype=jnp.int32), nl)
             last_error = last_error.at[rows].set(
                 jnp.where(errf, errc, 0), mode="drop")
+            last_error_loc = last_error_loc.at[rows].set(
+                jnp.where(errf, errl, 0), mode="drop")
             n_errors = n_errors + jnp.sum(errf.astype(jnp.int32))
         n_destroyed = jnp.int32(0)
         for s0, dstr in destroy_rows:
@@ -890,7 +898,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             for s0, errs in error_rows:
                 if errs is None:
                     continue
-                errf, _ = errs
+                errf = errs[0]
                 rows_ = s0 + jnp.arange(errf.shape[0], dtype=jnp.int32)
                 err_ev = err_ev.at[rows_].max(errf)
             classes = [(1, became_muted), (2, released_ev), (3, over_ev),
@@ -1007,7 +1015,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_destroyed=vec(st.n_destroyed[0] + n_destroyed),
             spawn_fail=vec(spawn_fail, jnp.bool_),
             n_collected=st.n_collected,
-            last_error=last_error,
+            last_error=last_error, last_error_loc=last_error_loc,
             n_errors=vec(st.n_errors[0] + n_errors),
             ev_data=ev_data, ev_count=vec(ev_count),
             ev_dropped=vec(ev_dropped),

@@ -217,6 +217,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             spawn_fail=st.spawn_fail,
             n_collected=st.n_collected + n_dead.reshape(1),
             last_error=jnp.where(dead, 0, st.last_error),
+            last_error_loc=jnp.where(dead, 0, st.last_error_loc),
             n_errors=st.n_errors,
             ev_data=st.ev_data, ev_count=st.ev_count,
             ev_dropped=st.ev_dropped,

@@ -22,6 +22,7 @@ one tick, as if steps were dispatched singly.
 from __future__ import annotations
 
 import collections
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -186,6 +187,7 @@ class Runtime:
         self._last_gc_step = 0
         self._next_gc = self.opts.gc_initial   # ≙ heap.c next_gc
         self._host_errors: Dict[int, int] = {}
+        self._host_error_locs: Dict[int, str] = {}
 
     # Any state assignment — including a driver pushing rt._step results
     # back, as bench.py does — conservatively invalidates the cached
@@ -722,6 +724,7 @@ class Runtime:
                     # ≙ a behaviour-local `try...else` (fork int-coded
                     # errors): record the code, actor continues.
                     self._host_errors[aid] = e.code
+                    self._host_error_locs[aid] = e.loc
                     self.totals["host_errors"] += 1
                     st2 = st
                 self._host_state[aid] = st2 if st2 is not None else st
@@ -855,6 +858,42 @@ class Runtime:
         if self.program.cohort_of(actor_id).host:
             return self._host_errors.get(int(actor_id), 0)
         return int(self.state.last_error[actor_id])
+
+    def last_error_loc(self, actor_id: int) -> str:
+        """Source location of the latest error (≙ the fork's
+        __error_loc): the Python file:line of the ctx.error_int call
+        site (device) or the PonyError raise site (host); "?" = none."""
+        from ..errors import error_site
+        if self.program.cohort_of(actor_id).host:
+            return self._host_error_locs.get(int(actor_id), "?")
+        return error_site(int(self.state.last_error_loc[actor_id]))
+
+    def total_memory(self) -> Dict[str, int]:
+        """Process + device memory accounting (≙ the fork's
+        @ponyint_total_memory, DIVERGENCE.md: the runtime knows its
+        OS-visible memory use). Returns bytes: host RSS, device state
+        (the actor world's HBM footprint), and the native pool's live
+        block count."""
+        try:    # current RSS (Linux); peak via getrusage as fallback
+            with open("/proc/self/statm") as f:
+                rss_bytes = (int(f.read().split()[1])
+                             * (os.sysconf("SC_PAGE_SIZE")))
+        except OSError:
+            import resource
+            rss_bytes = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+        dev = 0
+        if self.state is not None:
+            dev = sum(leaf.nbytes for leaf in jax.tree.leaves(self.state))
+        try:
+            from .. import native
+            pool_live, pool_recycled = native.pool_stats()
+        except Exception:                     # noqa: BLE001 — lib unbuilt
+            pool_live = pool_recycled = 0
+        return {"host_rss_bytes": int(rss_bytes),
+                "device_state_bytes": dev,
+                "pool_live_blocks": int(pool_live),
+                "pool_recycled_blocks": int(pool_recycled)}
 
     def check_invariants(self) -> None:
         """Debug-build queue/flag invariants (≙ well_formed_msg_chain +

@@ -145,6 +145,9 @@ class RtState:
     n_collected: jnp.ndarray  # [P] int32 — actors freed by GC (gc.py)
     last_error: jnp.ndarray   # [N] int32 — latest ctx.error_int code
     #                              (0 = none; ≙ fork's pony_error_code)
+    last_error_loc: jnp.ndarray  # [N] int32 — trace-site id of that
+    #                              error (errors.error_site resolves it;
+    #                              ≙ fork's __error_loc string table)
     n_errors: jnp.ndarray     # [P] int32 — error_int events
 
     # Per-event trace ring (analysis level 3; ≙ the fork's per-event
@@ -226,6 +229,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         spawn_fail=jnp.zeros((p,), jnp.bool_),
         n_collected=jnp.zeros((p,), i32),
         last_error=jnp.zeros((n,), i32),
+        last_error_loc=jnp.zeros((n,), i32),
         n_errors=jnp.zeros((p,), i32),
         ev_data=jnp.zeros(
             (3, p * (opts.analysis_events if opts.analysis >= 3 else 0)),

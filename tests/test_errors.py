@@ -109,3 +109,79 @@ def test_invariants_hold_through_pressure():
     rt.run(max_steps=200)
     rt.check_invariants()
     assert rt.counter("n_delivered") > 0
+
+
+def test_device_error_location_resolves_to_call_site():
+    """last_error_loc resolves to the ctx.error_int call site's
+    file:line (≙ the fork's __error_loc, DIVERGENCE.md)."""
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+    @actor
+    class Erring:
+        n: I32
+
+        @behaviour
+        def go(self, st, v: I32):
+            self.error_int(42, when=v > 10)      # <- the site under test
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=1, msg_words=1,
+                                max_sends=1, spill_cap=16, inject_slots=4))
+    rt.declare(Erring, 2).start()
+    a, b = rt.spawn(Erring), rt.spawn(Erring)
+    rt.send(a, Erring.go, 99)
+    rt.send(b, Erring.go, 1)
+    rt.run()
+    assert rt.last_error(a) == 42
+    loc = rt.last_error_loc(a)
+    assert loc.endswith(".py:" + loc.rsplit(":", 1)[1])
+    assert "test_errors" in loc
+    assert rt.last_error(b) == 0
+    assert rt.last_error_loc(b) == "?"
+
+
+def test_host_error_location_from_pony_error():
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+    from ponyc_tpu.errors import PonyError
+
+    @actor
+    class H:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def go(self, st, v: I32):
+            if v > 5:
+                raise PonyError(7, "boom")
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=1, msg_words=1,
+                                max_sends=1, spill_cap=16, inject_slots=4))
+    rt.declare(H, 1).start()
+    h = rt.spawn(H)
+    rt.send(h, H.go, 9)
+    rt.run()
+    assert rt.last_error(h) == 7
+    assert "test_errors" in rt.last_error_loc(h)
+
+
+def test_total_memory_accounting():
+    """≙ @ponyint_total_memory (fork): the runtime reports its memory."""
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+    @actor
+    class M:
+        n: I32
+
+        @behaviour
+        def go(self, st, v: I32):
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, msg_words=2,
+                                max_sends=1, spill_cap=32, inject_slots=4))
+    rt.declare(M, 256).start()
+    mem = rt.total_memory()
+    assert mem["host_rss_bytes"] > 1 << 20
+    # buf alone is cap*w1*N*4 bytes
+    assert mem["device_state_bytes"] >= 8 * 3 * 256 * 4
+    assert mem["pool_live_blocks"] >= 0
