@@ -252,6 +252,10 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             sender_local = (lsnd >= 0) & (lsnd < n)
             sc = jnp.minimum(jnp.maximum(lsnd, 0), n - 1)
             sender_hot = occ_after[sc] > overload_occ
+            if pressured is not None:
+                # ≙ the UNDER_PRESSURE half of the sender exemption: a
+                # sender that itself declared pressure never mutes.
+                sender_hot = sender_hot | pressured[sc]
             trig = ok & sender_local & (rej | recv_hot) & ~sender_hot
             mute_row = jnp.where(trig, sc, n)
             newly_muted = jnp.zeros((n,), jnp.bool_).at[mute_row].max(

@@ -434,7 +434,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
 
 def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
            rspill_cap: int, overload_occ, head, tail, shard_base,
-           mute_slots: int):
+           mute_slots: int, pressured_global, pressured_local):
     """Mesh routing: pack entries into per-destination-shard buckets and
     exchange them with one all_to_all over the actor axis (ICI).
 
@@ -479,10 +479,18 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
 
     nrej = jnp.sum(cnt - acc)
     w1 = words.shape[0]
+    # Sends whose (possibly remote) target DECLARED pressure: the
+    # cross-shard face of pony_apply_backpressure — every shard sees the
+    # all-gathered pressured bits, so senders mute at routing time, not
+    # only on the receiver's shard (≙ the reference muting any scheduler
+    # that sends to an under-pressure actor).
+    pr_t = (ts >= 0) & jnp.take(
+        pressured_global, jnp.maximum(ts, 0), mode="clip")
 
     def pressure(_):
         # Bucket overflow → route spill (stays on this shard, ordered)
-        # + mute the (always local) senders of parked messages.
+        # + mute the (always local) senders of parked or
+        # pressured-targeted messages.
         rank = jnp.arange(e, dtype=jnp.int32) - seg_start[
             jnp.minimum(dt, shards - 1)]
         rej = (dt < shards) & (rank >= bucket)
@@ -493,10 +501,15 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
             words=jnp.where(vsp[None, :], ws[:, perm2], 0),
         )
         lsnd = ss - shard_base
-        s_ok = rej & (lsnd >= 0) & (lsnd < n_local)
+        s_ok = (rej | pr_t) & (lsnd >= 0) & (lsnd < n_local)
         sc = jnp.minimum(jnp.maximum(lsnd, 0), n_local - 1)
         s_hot = (tail[sc] - head[sc]) > overload_occ
-        trig = s_ok & ~s_hot
+        # ≙ the reference's !OVERLOADED/UNDER_PRESSURE sender exemption
+        # (actor.c mute rules): a sender that is itself hot or has
+        # itself declared pressure never mutes — prevents two
+        # host-pressured actors that message each other from
+        # mutually muting into a stall.
+        trig = s_ok & ~s_hot & ~pressured_local[sc]
         mute_row = jnp.where(trig, sc, n_local)
         newly_muted = jnp.zeros((n_local,), jnp.bool_).at[mute_row].max(
             trig, mode="drop")
@@ -512,7 +525,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
                 jnp.zeros((n_local,), jnp.bool_), refs, ovf)
 
     new_rspill, newly_muted, new_refs, new_ovf = lax.cond(
-        nrej > 0, pressure, quiet, operand=None)
+        (nrej > 0) | jnp.any(pr_t), pressure, quiet, operand=None)
 
     received = Entries(tgt=rt, sender=rs, words=rw)
     return (received, new_rspill, jnp.minimum(nrej, rspill_cap),
@@ -553,6 +566,16 @@ def build_step(program: Program, opts: RuntimeOptions):
             shard = jnp.int32(0)
         base = shard * nl
         occ0 = st.tail - st.head
+        # Mesh-wide pressured bits (≙ pony_apply_backpressure being
+        # visible to every scheduler): one all_gather of the [nl] bool
+        # column per tick — bandwidth-trivial next to the routing
+        # all_to_all, and it lets BOTH the routing mute and the remote
+        # unmute guard see off-shard pressure.
+        if p > 1:
+            pressured_global = lax.all_gather(
+                st.pressured, "actors", tiled=True)
+        else:
+            pressured_global = st.pressured
 
         # --- 1. unmute pass (≙ ponyint_sched_unmute_senders,
         # scheduler.c:1552-1635: receiver recovered → senders released).
@@ -580,15 +603,24 @@ def build_step(program: Program, opts: RuntimeOptions):
             # Remote muting ref: release once this shard's route-spill
             # drained (the local evidence of congestion is gone;
             # receiver-side pressure will re-mute via routing if it
-            # persists).
-            remote_ok = has & ~ref_local & (st.rspill_count[0] == 0)
+            # persists) — unless the remote receiver still DECLARES
+            # pressure (the all-gathered bits above), which holds the
+            # sender muted exactly as a local pressured ref would.
+            remote_pr = jnp.take(pressured_global,
+                                 jnp.maximum(refs, 0),
+                                 mode="clip") & has & ~ref_local
+            remote_ok = (has & ~ref_local & (st.rspill_count[0] == 0)
+                         & ~remote_pr)
             slot_ok = ~has | local_ok | remote_ok
             all_ok = jnp.all(slot_ok, axis=0)
             # Overflowed ref sets (more distinct muters than slots) defer
             # to a shard-wide quiet condition — conservative, never early.
+            # Overflowed ref sets may have EVICTED a pressured ref
+            # (slot collision), so the conservative release condition
+            # consults the whole world's pressure bits, not just local.
             shard_quiet = (jnp.max(occ0) <= opts.unmute_occ) \
                 & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0) \
-                & ~jnp.any(st.pressured)
+                & ~jnp.any(pressured_global)
             release = st.muted & all_ok & (~st.mute_ovf | shard_quiet)
             return (st.muted & ~release,
                     jnp.where(release[None, :], -1, refs),
@@ -765,7 +797,9 @@ def build_step(program: Program, opts: RuntimeOptions):
                 out_cat, shards=p, n_local=nl, bucket=bucket,
                 rspill_cap=s_cap, overload_occ=opts.overload_occ,
                 head=new_head, tail=tail0, shard_base=base,
-                mute_slots=opts.mute_slots)
+                mute_slots=opts.mute_slots,
+                pressured_global=pressured_global,
+                pressured_local=st.pressured)
             incoming = incoming._replace(
                 tgt=jnp.where(incoming.tgt >= 0, incoming.tgt - base, -1))
         else:

@@ -141,3 +141,45 @@ def test_mesh_serialise_roundtrip_under_pressure(tmp_path):
     rt2.run(max_steps=400)
     assert rt2.state_of(int(sink))["got"] == 48 * 4
     assert not np.asarray(rt2.state.muted).any()
+
+
+def test_programmatic_backpressure_on_mesh():
+    """apply_backpressure on a sharded world: senders on EVERY shard mute
+    when their sends target the pressured (remote) receiver, and release
+    after the host clears it (the pressured column shards with the actor
+    axis). mailbox_cap is large enough that occupancy muting
+    (overload_occ) can never fire — any mute is the programmatic path."""
+    opts = RuntimeOptions(mailbox_cap=64, batch=4, max_sends=2,
+                          msg_words=2, mesh_shards=4, spill_cap=512,
+                          inject_slots=64, quiesce_interval=1)
+    rt, sink, srcs = _run_pressure(opts, n_src=16, items=40)
+    inj = rt._drain_inject()
+    st, aux = rt._step(rt.state, *inj)
+    inj = rt._empty_inject
+    st, aux = rt._step(st, *inj)
+    rt.state = st
+    assert not np.asarray(st.muted).any(), "no pressure yet"
+
+    rt.apply_backpressure([int(sink)])
+    st = rt.state
+    for _ in range(3):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    muted = np.asarray(st.muted)
+    occ = int(np.asarray(st.tail - st.head)[int(sink)])
+    assert muted.any(), "pressured receiver must mute senders"
+    assert occ <= rt.opts.overload_occ, \
+        "mute was pressure-driven, not occupancy-driven"
+    # The pressure signal must cross the mesh: some muted sender lives on
+    # a different shard than the sink (ids are shard-major: shard = id //
+    # n_local).
+    n_local = rt.program.n_local
+    sink_shard = int(sink) // n_local
+    muted_shards = set(int(i) // n_local for i in np.nonzero(muted)[0])
+    assert muted_shards - {sink_shard}, \
+        f"only shard {sink_shard} muted: {muted_shards}"
+
+    rt.release_backpressure([int(sink)])
+    assert rt.run(max_steps=4000) == 0
+    assert rt.state_of(int(sink))["got"] == 16 * 40
+    assert not np.asarray(rt.state.muted).any()
