@@ -245,6 +245,13 @@ def main():
                   "(PONY_TPU_BENCH_ALLOW_CPU=0 to make this fatal). "
                   f"Last error: {tpu_error}", file=sys.stderr)
             force_cpu()
+            # A 1M-actor world on the CPU backend takes minutes per
+            # window; shrink the default size so a wedged-tunnel run
+            # still records a bounded (clearly-labelled) result.
+            if args.actors >= 1 << 18:
+                args.actors = 1 << 17
+                print("bench: CPU fallback shrinks --actors to "
+                      f"{args.actors}", file=sys.stderr)
     # --platform tpu: no forcing, let init fail loudly in-process.
 
     import jax
