@@ -51,6 +51,11 @@ class RuntimeOptions:
     spill_cap: int = 4096          # device overflow-spill entries (≙ the
     #   unbounded pool-backed queues of the reference; bounded here because
     #   XLA shapes are static — overflow beyond this raises)
+    mute_age_limit: int = 32       # consecutive muted ticks before a
+    #   sender is force-released (the lockstep deadlock-breaker for
+    #   mutual-mute cycles/chains — see state.mute_age; short enough to
+    #   bound stall time, long enough that ordinary backpressure mutes
+    #   release via recovery, not aging)
     mute_slots: int = 4            # muting-receiver refs tracked per sender
     #   (≙ mutemap.c's receiver-set + actor.h mute counters: unmute only
     #   when *every* tracked muting receiver recovers; refs hash into

@@ -58,6 +58,10 @@ class StepAux(NamedTuple):
     exit_code: jnp.ndarray       # int32
     spill_overflow: jnp.ndarray  # bool — fatal: a spill buffer exceeded
     spawn_fail: jnp.ndarray      # bool — fatal: ctx.spawn found no slot
+    any_muted: jnp.ndarray       # bool — some actor still carries a mute
+    #   flag; run() uses it for bounded CLEANUP ticks at quiescence so a
+    #   terminated world ends unmuted (the unmute pass lags the drain
+    #   that satisfies it by one tick)
     n_processed: jnp.ndarray     # int32 — *cumulative* behaviours run
     n_delivered: jnp.ndarray     # int32 — *cumulative* deliveries
     # (cumulative = state counters; the host accumulates mod-2^32 deltas,
@@ -621,7 +625,37 @@ def build_step(program: Program, opts: RuntimeOptions):
             shard_quiet = (jnp.max(occ0) <= opts.unmute_occ) \
                 & (st.dspill_count[0] == 0) & (st.rspill_count[0] == 0) \
                 & ~jnp.any(pressured_global)
-            release = st.muted & all_ok & (~st.mute_ovf | shard_quiet)
+            # Aging deadlock-breaker: a sender muted for
+            # mute_age_limit consecutive ticks force-releases even if
+            # its muters look unrecovered. Mutual-mute cycles and
+            # chains (A muted-by B muted-by C...) can otherwise never
+            # drain — the known deadlock of the reference's pre-0.36
+            # backpressure, where every muter must RUN to recover and
+            # muted actors don't run. Bounded queues + spill make the
+            # periodic release safe: each release round dispatches real
+            # work, and overflow still fails loudly. Host-declared
+            # pressure is exempt (never aged away).
+            # Staggered by actor row (threshold in [limit, 2*limit)):
+            # a fan-in that muted thousands of senders on one tick would
+            # otherwise release them all on one tick too, and the
+            # synchronized wave into the still-full receiver could blow
+            # the bounded spill. Phasing spreads releases over `limit`
+            # ticks, so the per-tick wave is ~n_muted/limit.
+            lim = max(1, opts.mute_age_limit)
+            threshold = lim + jnp.arange(nl, dtype=jnp.int32) % lim
+            aged = st.mute_age >= threshold
+            held_by_pressure = jnp.any(
+                (refs >= 0) & jnp.take(
+                    pressured_global, jnp.maximum(refs, 0), mode="clip"),
+                axis=0)
+            # Overflowed ref sets may have EVICTED a pressured ref, so
+            # aging defers while any pressure exists anywhere — the same
+            # conservative rule as the non-aged ovf path.
+            aged_ok = (aged & ~held_by_pressure
+                       & (~st.mute_ovf | ~jnp.any(pressured_global)))
+            release = st.muted & (
+                (all_ok & (~st.mute_ovf | shard_quiet))
+                | aged_ok)
             return (st.muted & ~release,
                     jnp.where(release[None, :], -1, refs),
                     st.mute_ovf & ~release)
@@ -896,6 +930,12 @@ def build_step(program: Program, opts: RuntimeOptions):
         newly = (res.newly_muted | route_muted) & alive
         became_muted = newly & ~muted
         muted2 = muted | newly
+        # Consecutive-muted-tick counter (see the aging release above):
+        # +1 while muted, reset on release or fresh mute.
+        mute_age2 = jnp.where(muted2,
+                              jnp.where(became_muted, 0,
+                                        st.mute_age + 1),
+                              0)
 
         def merge_mutes(_):
             inc_refs, c1 = _merge_slots(res.new_mute_refs, route_refs)
@@ -984,6 +1024,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             nrej_all = nbad_all = ndl_all = nmut_all = jnp.int32(0)
         local_pending = (jnp.any(occ_after[:fh] > 0)
                          | (res.spill_count > 0) | (rsp_count > 0))
+        any_muted_local = jnp.any(muted2)
         host_pending = (jnp.any(occ_after[fh:] > 0) if fh < nl
                         else jnp.bool_(False))
         # Sticky: once any step overflowed, every later aux reports it, so
@@ -994,6 +1035,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                 spawn_fail.astype(jnp.int32), "actors") > 0
             device_pending = lax.psum(
                 local_pending.astype(jnp.int32), "actors") > 0
+            any_muted_all = lax.psum(
+                any_muted_local.astype(jnp.int32), "actors") > 0
             host_pending = lax.psum(
                 host_pending.astype(jnp.int32), "actors") > 0
             exit_any = lax.psum(exit_f.astype(jnp.int32), "actors") > 0
@@ -1017,6 +1060,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         else:
             spawn_fail_any = spawn_fail
             device_pending = local_pending
+            any_muted_all = any_muted_local
             exit_any = exit_f
             exit_code_all = exit_c
             overflow_any = overflow
@@ -1029,6 +1073,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         st2 = RtState(
             buf=res.buf, head=new_head, tail=new_tail,
             alive=alive, muted=muted2, mute_refs=mute_refs2,
+            mute_age=mute_age2,
             mute_ovf=mute_ovf2, pinned=pinned, pressured=pressured,
             dspill_tgt=res.spill.tgt, dspill_sender=res.spill.sender,
             dspill_words=res.spill.words,
@@ -1060,6 +1105,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         aux = StepAux(
             device_pending=device_pending,
             host_pending=host_pending,
+            any_muted=any_muted_all,
             exit_flag=exit_any, exit_code=exit_code_all,
             spill_overflow=overflow_any,
             spawn_fail=spawn_fail_any,
@@ -1112,6 +1158,7 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
         i32, b = jnp.int32, jnp.bool_
         aux0 = StepAux(
             device_pending=b(True), host_pending=b(False),
+            any_muted=b(False),
             exit_flag=b(False), exit_code=i32(0),
             spill_overflow=b(False), spawn_fail=b(False),
             n_processed=i32(0), n_delivered=i32(0),

@@ -200,6 +200,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             alive=st.alive & ~dead,
             muted=st.muted & ~dead,
             mute_refs=jnp.where(dead[None, :], -1, st.mute_refs),
+            mute_age=jnp.where(dead, 0, st.mute_age),
             mute_ovf=st.mute_ovf & ~dead,
             pinned=st.pinned & ~dead,
             pressured=st.pressured & ~dead,

@@ -816,11 +816,35 @@ class Runtime:
             busy = (bool(a.device_pending) or bool(a.host_pending)
                     or bool(self._inject_q))
             if not busy:
-                if self._noisy == 0 and not self._bridge_pollers:
+                terminating = (self._noisy == 0
+                               and (not self._bridge_pollers
+                                    or idle_polls >= 2))
+                if terminating:
+                    # Cleanup ticks ON THE TERMINATION PATH ONLY: the
+                    # unmute pass lags the drain that satisfies it by
+                    # one tick, so a program can quiesce with cosmetic
+                    # mute-flag residue. Bounded — pressure a host
+                    # never released legitimately holds mutes and must
+                    # not livelock termination; a merely-waiting
+                    # (noisy) program never pays these ticks.
+                    cleanup = 0
+                    while (bool(a.any_muted) and cleanup < 3
+                           and (max_steps is None
+                                or steps_this_run < max_steps)):
+                        self.state, aux2, kdev = self._multi(
+                            self.state, *self._empty_inject, jnp.int32(1))
+                        a = jax.device_get(aux2)
+                        k2 = int(jax.device_get(kdev))
+                        self.steps_run += k2
+                        steps_this_run += k2
+                        cleanup += 1
+                    if getattr(self, "_analysis", None) is not None \
+                            and cleanup:
+                        # Drain the unmute trace events the cleanup
+                        # ticks generated (analysis level 3).
+                        self._analysis.window(a)
                     break  # quiescent: terminate (≙ ACK'd CNF token)
                 idle_polls += 1
-                if self._noisy == 0 and idle_polls > 2:
-                    break
                 # Waiting on external events (timers/fds): back off
                 # exponentially instead of hot-spinning device steps
                 # (≙ the fork's scaling_sleep, scheduler.c:918-935).

@@ -101,6 +101,14 @@ class RtState:
     #                              mutemap receiver-set per sender
     #                              (mutemap.c; scheduler.c:1478-1635):
     #                              release only when all recover.
+    mute_age: jnp.ndarray     # [N] int32 — consecutive ticks spent muted
+    #                              (0 when unmuted). Past opts.mute_age_limit
+    #                              the unmute pass force-releases: the
+    #                              lockstep deadlock-breaker for
+    #                              mutual-mute cycles/chains (the
+    #                              reference's pre-0.36 backpressure can
+    #                              deadlock here; bounded queues + spill
+    #                              make periodic release safe for us)
     mute_ovf: jnp.ndarray     # [N] bool — more distinct muters than slots
     #                              (hash collision); release deferred until
     #                              the shard is globally quiet
@@ -203,6 +211,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         alive=jnp.zeros((n,), jnp.bool_),
         muted=jnp.zeros((n,), jnp.bool_),
         mute_refs=jnp.full((opts.mute_slots, n), -1, i32),
+        mute_age=jnp.zeros((n,), i32),
         mute_ovf=jnp.zeros((n,), jnp.bool_),
         pinned=jnp.zeros((n,), jnp.bool_),
         pressured=jnp.zeros((n,), jnp.bool_),

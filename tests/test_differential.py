@@ -1,0 +1,143 @@
+"""Randomized differential testing: the device engine vs a sequential
+Python oracle of actor semantics.
+
+≙ the role the aggregated stdlib test binary plays for the reference
+(packages/stdlib/_test.pony) — broad behavioural coverage — plus the
+layer the reference lacks (SURVEY.md §4): direct scheduler/delivery
+semantics checks. Message outcomes here are commutative (per-actor sums
+and counts), so the terminal state is schedule-independent: ANY correct
+scheduler — the reference's work-stealing M:N, our lockstep ticks, the
+oracle's sequential walk — must produce identical columns. Tiny mailbox
+caps force the spill → mute → unmute machinery; the mesh variants force
+routing and cross-shard spill; both delivery formulations must agree.
+"""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+
+@actor
+class Walker:
+    """Token walk over a random functional graph: receive v, accumulate,
+    forward v-1 to this actor's fixed successor while v > 0."""
+    acc: I32
+    hits: I32
+    nxt: Ref["Walker"]
+
+    MAX_SENDS = 1
+
+    @behaviour
+    def step(self, st, v: I32):
+        self.send(st["nxt"], Walker.step, v - 1, when=v > 0)
+        return {**st, "acc": st["acc"] + v, "hits": st["hits"] + 1}
+
+
+@actor
+class Splitter:
+    """Receive v: accumulate, and while v > 0 send v-1 to BOTH a Walker
+    and another Splitter (bounded binary fan-out — message count grows
+    then dies; exercises bursts far above mailbox capacity)."""
+    acc: I32
+    w_ref: Ref["Walker"]
+    s_ref: Ref["Splitter"]
+
+    MAX_SENDS = 2
+
+    @behaviour
+    def burst(self, st, v: I32):
+        self.send(st["w_ref"], Walker.step, v - 1, when=v > 0)
+        self.send(st["s_ref"], Splitter.burst, v - 2, when=v > 1)
+        return {**st, "acc": st["acc"] + v}
+
+
+def oracle(n_w, n_s, w_nxt, s_w, s_s, seeds):
+    """Sequential simulator with unbounded FIFO queues (the reference's
+    semantics modulo scheduling, which the commutative outcome erases)."""
+    from collections import deque
+    w_acc = np.zeros(n_w, np.int64)
+    w_hits = np.zeros(n_w, np.int64)
+    s_acc = np.zeros(n_s, np.int64)
+    q = deque(seeds)                       # ('w'|'s', idx, v)
+    while q:
+        kind, i, v = q.popleft()
+        if kind == "w":
+            w_acc[i] += v
+            w_hits[i] += 1
+            if v > 0:
+                q.append(("w", w_nxt[i], v - 1))
+        else:
+            s_acc[i] += v
+            if v > 0:
+                q.append(("w", s_w[i], v - 1))
+            if v > 1:
+                q.append(("s", s_s[i], v - 2))
+    return w_acc, w_hits, s_acc
+
+
+def run_device(n_w, n_s, w_nxt, s_w, s_s, seeds, opts):
+    rt = Runtime(opts)
+    rt.declare(Walker, n_w).declare(Splitter, n_s)
+    rt.start()
+    wids = rt.spawn_many(Walker, n_w)
+    sids = rt.spawn_many(Splitter, n_s)
+    rt.set_fields(Walker, wids, nxt=wids[np.asarray(w_nxt)])
+    rt.set_fields(Splitter, sids, w_ref=wids[np.asarray(s_w)],
+                  s_ref=sids[np.asarray(s_s)])
+    for kind, i, v in seeds:
+        if kind == "w":
+            rt.send(int(wids[i]), Walker.step, v)
+        else:
+            rt.send(int(sids[i]), Splitter.burst, v)
+    assert rt.run(max_steps=300_000) == 0, "must quiesce"
+    wst = rt.cohort_state(Walker)
+    sst = rt.cohort_state(Splitter)
+    assert not np.asarray(rt.state.muted).any(), "terminal world unmuted"
+    return (wst["acc"].astype(np.int64), wst["hits"].astype(np.int64),
+            sst["acc"].astype(np.int64))
+
+
+def _case(seed, n_w=24, n_s=8, n_seeds=10, vmax=14):
+    rng = np.random.default_rng(seed)
+    w_nxt = rng.integers(0, n_w, n_w)
+    s_w = rng.integers(0, n_w, n_s)
+    s_s = rng.integers(0, n_s, n_s)
+    seeds = []
+    for _ in range(n_seeds):
+        if rng.random() < 0.6:
+            seeds.append(("w", int(rng.integers(0, n_w)),
+                          int(rng.integers(1, vmax))))
+        else:
+            seeds.append(("s", int(rng.integers(0, n_s)),
+                          int(rng.integers(2, vmax))))
+    return w_nxt, s_w, s_s, seeds
+
+
+CONFIGS = [
+    ("tiny-cap-forces-spill", dict(mailbox_cap=2, batch=1, msg_words=1,
+                                   max_sends=2, spill_cap=512,
+                                   inject_slots=16)),
+    ("cosort", dict(mailbox_cap=4, batch=2, msg_words=1, max_sends=2,
+                    spill_cap=512, inject_slots=16, delivery="cosort")),
+    ("mesh4", dict(mailbox_cap=4, batch=2, msg_words=1, max_sends=2,
+                   spill_cap=1024, inject_slots=32, mesh_shards=4,
+                   quiesce_interval=2)),
+    ("mesh4-tiny-bucket", dict(mailbox_cap=2, batch=1, msg_words=1,
+                               max_sends=2, spill_cap=2048,
+                               inject_slots=32, mesh_shards=4,
+                               route_bucket=8, quiesce_interval=1)),
+]
+
+
+@pytest.mark.parametrize("name,okw", CONFIGS, ids=[c[0] for c in CONFIGS])
+@pytest.mark.parametrize("seed", [7, 23])
+def test_device_matches_oracle(name, okw, seed):
+    n_w, n_s = 24, 8
+    w_nxt, s_w, s_s, seeds = _case(seed, n_w, n_s)
+    want = oracle(n_w, n_s, w_nxt, s_w, s_s, seeds)
+    got = run_device(n_w, n_s, w_nxt, s_w, s_s, seeds,
+                     RuntimeOptions(**okw))
+    for g, w, what in zip(got, want, ("w_acc", "w_hits", "s_acc")):
+        assert (g == w).all(), (
+            name, seed, what, np.nonzero(g != w)[0][:5], g.sum(), w.sum())
