@@ -100,6 +100,11 @@ class RuntimeOptions:
     #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
     #   XLA select-chain; interpret-mode on CPU. Off until measured
     #   faster on the real chip.
+    pallas_fused: bool = False     # fuse drain + behaviour + outbox into
+    #   ONE Pallas kernel per eligible cohort (ops/fused_dispatch.py:
+    #   single behaviour, no spawns/destroy/error/sync-construction;
+    #   others fall back to the XLA path). The north-star dispatch
+    #   kernel; off until measured on the real chip.
     delivery: str = "plan"         # delivery formulation (delivery.py):
     #   "plan"   — cached stable-sort plan + permutation gathers (skips
     #              the sort when traffic shape repeats);

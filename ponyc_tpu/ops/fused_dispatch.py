@@ -1,0 +1,199 @@
+"""Fused Pallas dispatch kernel: drain + behaviour + outbox in ONE pass.
+
+≙ the whole of ponyint_actor_run's hot loop (src/libponyrt/actor/
+actor.c:383-549) for one cohort — message pop, dispatch into the
+behaviour body, and the send path's message construction — executed as
+a single TPU kernel over lane blocks. This is the kernel BASELINE.json's
+north star names ("actor state + mailboxes laid out struct-of-arrays in
+HBM and behaviour dispatch run as a vmapped/Pallas kernel"): one grid
+step pulls a [cap, w1, LB] mailbox tile and the cohort's state lanes
+into VMEM ONCE, iterates the batch slots in-register, evaluates the
+(traced, planar) behaviour body on the lanes, and writes the new state,
+outbox planes and head advance — where the XLA path makes `batch`
+separate select-chain passes over the mailbox block plus materialised
+scan intermediates.
+
+Eligibility (checked by `eligible()` — everything else falls back to
+the XLA path, same semantics):
+  - single-behaviour cohort (the dispatch select degenerates);
+  - no device spawns, no destroy, no error_int, no sync-construction
+    (those effects need the engine's reservation/row bookkeeping);
+  - behaviour body uses only elementwise/lane ops. This is the API
+    contract anyway — a behaviour describes ONE actor's reaction, so
+    lane-crossing ops (reductions over the cohort) have no defined
+    meaning in either formulation; under the fused kernel they would
+    additionally see only their 1024-lane grid block. Not statically
+    detectable, hence contract + documentation, like vmap's own
+    semantics.
+
+Gating: `RuntimeOptions.pallas_fused` (off by default until measured on
+the real chip; interpret mode exercises the kernel on CPU in the suite).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import pack
+
+LANE_BLOCK = 1024
+
+
+def eligible(cohort, effects, opts) -> bool:
+    """Structural + trace-discovered preconditions for the fused path."""
+    return (len(cohort.behaviours) == 1
+            and not cohort.spawns
+            and not effects["destroy"]
+            and not effects["error"]
+            and not effects["sync_init"])
+
+
+def _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms, lanes):
+    """The planar behaviour evaluator for eligible cohorts: the SAME
+    shared core as the XLA path (engine.eval_behaviour — one
+    implementation, so the two formulations cannot drift), minus the
+    spawn/destroy/error packaging eligibility excludes."""
+
+    def branch(st, payload, ids_vec):
+        from ..runtime.engine import eval_behaviour
+        ctx, st2, tgts, words = eval_behaviour(
+            bdef, st, payload, ids_vec, msg_words=msg_words,
+            field_specs=field_specs, field_dtypes=field_dtypes,
+            lanes=lanes, max_sends=ms)
+        b = jnp.bool_
+        return (st2, tgts, words,
+                jnp.broadcast_to(jnp.asarray(ctx.exit_flag, b), (lanes,)),
+                jnp.broadcast_to(jnp.asarray(ctx.exit_code, jnp.int32),
+                                 (lanes,)),
+                jnp.broadcast_to(jnp.asarray(ctx.yield_flag, b), (lanes,)))
+
+    return branch
+
+
+def build_fused_dispatch(bdef, *, base_gid: int, field_names: Sequence[str],
+                         field_dtypes, field_specs, batch: int, cap: int,
+                         msg_words: int, ms: int, rows: int,
+                         noyield: bool, interpret: bool):
+    """Returns fn(fields_tuple, buf, head, n_run, ids) →
+    (new_fields_tuple, out_tgt [batch*ms*rows], out_words [w1, b*ms*rows],
+    new_head [rows], nproc [rows], nbad [rows], ef [rows], ec [rows])
+    with EXACTLY the XLA path's semantics (engine busy_fn ordering:
+    entry (k, m, r) flattens k-major, then send slot, then lane)."""
+    w1 = 1 + msg_words
+    lb = min(LANE_BLOCK, rows)
+    assert rows % lb == 0, (rows, lb)
+    nf = len(field_names)
+    branch = _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms,
+                          lb)
+
+    def kernel(head_ref, nrun_ref, ids_ref, *refs):
+        field_refs = refs[:nf]
+        buf_ref = refs[nf]
+        out_field_refs = refs[nf + 1:nf + 1 + nf]
+        rest = refs[nf + 1 + nf:]
+        if ms:
+            (tgt_ref, words_ref, nh_ref, np_ref, nb_ref, ef_ref,
+             ec_ref) = rest
+        else:                         # send-less cohort: no outbox planes
+            tgt_ref = words_ref = None
+            nh_ref, np_ref, nb_ref, ef_ref, ec_ref = rest
+        head = head_ref[0]
+        nrun = nrun_ref[0]
+        ids = ids_ref[0]
+        st = {name: field_refs[i][0]
+              for i, name in enumerate(field_names)}
+        stopped = jnp.zeros((lb,), jnp.bool_)
+        ef = jnp.zeros((lb,), jnp.bool_)
+        ec = jnp.zeros((lb,), jnp.int32)
+        nproc = jnp.zeros((lb,), jnp.int32)
+        nbad = jnp.zeros((lb,), jnp.int32)
+        consumed = jnp.zeros((lb,), jnp.int32)
+        for k in range(batch):
+            slot = (head + k) % cap
+            msg = buf_ref[0]                     # [w1, LB]
+            for c in range(1, cap):
+                msg = jnp.where((slot == c)[None, :], buf_ref[c], msg)
+            valid = (nrun > k)
+            do_any = valid & ~stopped
+            in_range = msg[0] == base_gid        # single behaviour
+            do = do_any & in_range
+            st2, tgts, words, bef, bec, byf = branch(st, msg[1:], ids)
+            for i, name in enumerate(field_names):
+                st[name] = jnp.where(do, st2[name], st[name])
+            for m in range(ms):
+                tgt_ref[k * ms + m] = jnp.where(do, tgts[m],
+                                                jnp.int32(-1))
+                for w in range(w1):
+                    words_ref[(k * ms + m) * w1 + w] = jnp.where(
+                        do, words[m][w], jnp.int32(0))
+            del tgts, words
+            new_ef = do & bef
+            ec = jnp.where(new_ef & ~ef, bec, ec)
+            ef = ef | new_ef
+            if not noyield:
+                stopped = stopped | (do & byf)
+            nproc = nproc + do.astype(jnp.int32)
+            nbad = nbad + (do_any & ~in_range).astype(jnp.int32)
+            consumed = consumed + do_any.astype(jnp.int32)
+        for i in range(nf):
+            out_field_refs[i][0] = st[field_names[i]]
+        nh_ref[0] = head + consumed
+        np_ref[0] = nproc
+        nb_ref[0] = nbad
+        ef_ref[0] = ef.astype(jnp.int32)
+        ec_ref[0] = ec
+
+    @functools.partial(jax.jit)
+    def run(fields, buf, head, n_run, ids):
+        grid = (rows // lb,)
+        in_specs = (
+            [pl.BlockSpec((1, lb), lambda i: (0, i))] * 3
+            + [pl.BlockSpec((1, lb), lambda i: (0, i))] * nf
+            + [pl.BlockSpec((cap, w1, lb), lambda i: (0, 0, i))])
+        outbox_specs = ([pl.BlockSpec((batch * ms, lb),
+                                      lambda i: (0, i)),
+                         pl.BlockSpec((batch * ms * w1, lb),
+                                      lambda i: (0, i))] if ms else [])
+        outbox_shape = ([jax.ShapeDtypeStruct((batch * ms, rows),
+                                              jnp.int32),
+                         jax.ShapeDtypeStruct((batch * ms * w1, rows),
+                                              jnp.int32)] if ms else [])
+        out_specs = (
+            [pl.BlockSpec((1, lb), lambda i: (0, i))] * nf
+            + outbox_specs
+            + [pl.BlockSpec((1, lb), lambda i: (0, i))] * 5)
+        out_shape = (
+            [jax.ShapeDtypeStruct((1, rows), fields[i].dtype)
+             for i in range(nf)]
+            + outbox_shape
+            + [jax.ShapeDtypeStruct((1, rows), jnp.int32)] * 5)
+        outs = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape, interpret=interpret,
+        )(head[None, :], n_run[None, :], ids[None, :],
+          *[f[None, :] for f in fields], buf)
+        new_fields = tuple(outs[i][0] for i in range(nf))
+        e = batch * ms * rows
+        if ms:
+            tgt = outs[nf]                   # [batch*ms, rows]
+            words = outs[nf + 1]             # [batch*ms*w1, rows]
+            rest_out = outs[nf + 2:]
+            # Flatten to the engine's entry order: (k, m, lane) with
+            # lanes minor — words regroup to [w1, batch*ms*rows] planar.
+            out_tgt = tgt.reshape(e)
+            out_words = words.reshape(batch * ms, w1, rows)
+            out_words = jnp.moveaxis(out_words, 1, 0).reshape(w1, e)
+        else:
+            rest_out = outs[nf:]
+            out_tgt = jnp.full((e,), -1, jnp.int32)
+            out_words = jnp.zeros((w1, e), jnp.int32)
+        new_head, nproc, nbad, ef, ec = (o[0] for o in rest_out)
+        return (new_fields, out_tgt, out_words, new_head, nproc, nbad,
+                ef.astype(jnp.bool_), ec)
+
+    return run

@@ -102,6 +102,65 @@ def _bcast_lanes(v, dtype, lanes: int):
     return jnp.broadcast_to(jnp.asarray(v, dtype), (lanes,))
 
 
+
+def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
+                   field_specs, field_dtypes, lanes: int, max_sends: int,
+                   spawn_resv=None, spawn_meta=None):
+    """Shared behaviour-evaluation core: build the Context, tag typed
+    refs, run the traced body, validate + broadcast the state update,
+    and collect when-masked send planes padded to the send budget.
+    Used by BOTH dispatch formulations (the planar XLA branch below and
+    ops/fused_dispatch's kernel) so their semantics cannot drift.
+    Returns (ctx, st2, tgts, words)."""
+    w1 = 1 + msg_words
+    ctx = Context(ids_vec, msg_words, spawn_resv=spawn_resv,
+                  spawn_meta=spawn_meta)
+    args = pack.unpack_args(bdef.arg_specs, payload)
+    # Typed Ref[T] state fields and args enter the behaviour as PLAIN
+    # arrays whose trace-time identity is tagged with the declared
+    # type (pack.RefTypes), so Context.send verifies wiring at trace
+    # time (≙ type/safeto.c sendability; the verify pass of the
+    # build) without touching how refs behave under jnp ops.
+    for k, v in st.items():
+        ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
+    for spec, a in zip(bdef.arg_specs, args):
+        ctx.ref_types.tag(a, pack.ref_target(spec))
+    st2 = bdef.fn(ctx, dict(st), *args)
+    if st2 is None:
+        raise TypeError(
+            f"behaviour {bdef} must return the (possibly updated) state "
+            "dict")
+    if set(st2.keys()) != set(st.keys()):
+        raise TypeError(
+            f"behaviour {bdef} changed the state fields: "
+            f"{sorted(st2)} vs {sorted(st)}")
+    for k, v in st2.items():
+        want = pack.ref_target(field_specs[k])
+        got = ctx.ref_types.lookup(v)
+        if want is not None and got is not None and got != want:
+            raise TypeError(
+                f"sendability: behaviour {bdef} stores a Ref[{got}] "
+                f"into field {k!r} declared Ref[{want}]")
+    st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
+           for k, v in st2.items()}
+    if len(ctx.sends) > max_sends:
+        raise RuntimeError(
+            f"behaviour {bdef} performs {len(ctx.sends)} sends but the "
+            f"type's send budget is {max_sends}; set MAX_SENDS = "
+            f"{len(ctx.sends)} on the actor class")
+    tgts, words = [], []
+    for (t, w, when) in ctx.sends:
+        t = _bcast_lanes(t, jnp.int32, lanes)
+        when = _bcast_lanes(when, jnp.bool_, lanes)
+        w = jnp.broadcast_to(w.reshape(w1, -1), (w1, lanes))
+        tgts.append(jnp.where(when, t, jnp.int32(-1)))
+        words.append(w)
+    for _ in range(max_sends - len(ctx.sends)):
+        tgts.append(jnp.full((lanes,), -1, jnp.int32))
+        words.append(jnp.zeros((w1, lanes), jnp.int32))
+    return ctx, st2, tgts, words
+
+
 def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                  field_specs, spawn_sites, spawn_meta, effects,
                  lanes: int):
@@ -120,55 +179,15 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
     w1 = 1 + msg_words
 
     def branch(st, payload, ids_vec, resv_k):
-        ctx = Context(ids_vec, msg_words, spawn_resv=resv_k,
-                      spawn_meta=spawn_meta)
-        args = pack.unpack_args(bdef.arg_specs, payload)
-        # Typed Ref[T] state fields and args enter the behaviour as PLAIN
-        # arrays whose trace-time identity is tagged with the declared
-        # type (pack.RefTypes), so Context.send verifies wiring at trace
-        # time (≙ type/safeto.c sendability; the verify pass of the
-        # build) without touching how refs behave under jnp ops.
-        for k, v in st.items():
-            ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
-        for spec, a in zip(bdef.arg_specs, args):
-            ctx.ref_types.tag(a, pack.ref_target(spec))
-        st2 = bdef.fn(ctx, dict(st), *args)
+        ctx, st2, tgts, words = eval_behaviour(
+            bdef, st, payload, ids_vec, msg_words=msg_words,
+            field_specs=field_specs, field_dtypes=field_dtypes,
+            lanes=lanes, max_sends=max_sends, spawn_resv=resv_k,
+            spawn_meta=spawn_meta)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
         effects["error"] = effects["error"] or ctx.error_called
         effects["sync_init"] = (effects["sync_init"]
                                 or bool(ctx.sync_inits))
-        if st2 is None:
-            raise TypeError(
-                f"behaviour {bdef} must return the (possibly updated) state "
-                "dict")
-        if set(st2.keys()) != set(st.keys()):
-            raise TypeError(
-                f"behaviour {bdef} changed the state fields: "
-                f"{sorted(st2)} vs {sorted(st)}")
-        for k, v in st2.items():
-            want = pack.ref_target(field_specs[k])
-            got = ctx.ref_types.lookup(v)
-            if want is not None and got is not None and got != want:
-                raise TypeError(
-                    f"sendability: behaviour {bdef} stores a Ref[{got}] "
-                    f"into field {k!r} declared Ref[{want}]")
-        st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
-               for k, v in st2.items()}
-        if len(ctx.sends) > max_sends:
-            raise RuntimeError(
-                f"behaviour {bdef} performs {len(ctx.sends)} sends but the "
-                f"type's send budget is {max_sends}; set MAX_SENDS = "
-                f"{len(ctx.sends)} on the actor class")
-        tgts, words = [], []
-        for (t, w, when) in ctx.sends:
-            t = _bcast_lanes(t, jnp.int32, lanes)
-            when = _bcast_lanes(when, jnp.bool_, lanes)
-            w = jnp.broadcast_to(w.reshape(w1, -1), (w1, lanes))
-            tgts.append(jnp.where(when, t, jnp.int32(-1)))
-            words.append(w)
-        for _ in range(max_sends - len(ctx.sends)):
-            tgts.append(jnp.full((lanes,), -1, jnp.int32))
-            words.append(jnp.zeros((w1, lanes), jnp.int32))
         claims = []
         inits = []
         for tname, n in spawn_sites:
@@ -245,6 +264,30 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     nb = len(cohort.behaviours)
     base = cohort.behaviours[0].global_id if nb else 0
     sd = cohort.spawn_dispatches
+    fused = None
+    if opts.pallas_fused and nb == 1 and not cohort.spawns:
+        from ..ops import fused_dispatch as fd
+        from ..ops import mailbox_kernel as mk
+    if (opts.pallas_fused and nb == 1 and not cohort.spawns
+            and (rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0)):
+        # Probe-trace the branch so `effects` is discovered BEFORE the
+        # path decision (the fused kernel cannot host destroy/error/
+        # sync-construction bookkeeping).
+        jax.eval_shape(
+            branches[0],
+            {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
+             for f in cohort.atype.field_specs},
+            jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
+            jax.ShapeDtypeStruct((rows,), jnp.int32), {})
+        if fd.eligible(cohort, effects, opts):
+            fnames = tuple(cohort.atype.field_specs.keys())
+            fused = (fd.build_fused_dispatch(
+                cohort.behaviours[0], base_gid=base,
+                field_names=fnames, field_dtypes=field_dtypes,
+                field_specs=cohort.atype.field_specs, batch=batch,
+                cap=cap, msg_words=msg_words, ms=ms, rows=rows,
+                noyield=noyield, interpret=mk.interpret_mode()),
+                fnames)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
                    runnable_rows, ids, resv):
@@ -354,6 +397,21 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
         def busy_fn(_):
             n_run = jnp.where(runnable_rows,
                               jnp.minimum(occ_rows, batch), 0)
+            if fused is not None:
+                kernel_fn, fnames = fused
+                fields = tuple(type_state_rows[f] for f in fnames)
+                (nf_out, out_tgt, out_words, new_head, nproc_l, nbad_l,
+                 ef_l, ec_l) = kernel_fn(fields, buf_rows, head_rows,
+                                         n_run, ids)
+                stf = dict(zip(fnames, nf_out))
+                any_exit = jnp.any(ef_l)
+                code = ec_l[jnp.argmax(ef_l)]
+                zb = jnp.zeros((rows,), jnp.bool_)
+                zi = jnp.zeros((rows,), jnp.int32)
+                return (stf, out_tgt, out_words, new_head, any_exit,
+                        code, jnp.sum(nproc_l), jnp.sum(nbad_l),
+                        tuple(), tuple(), jnp.bool_(False), zb, zb, zi,
+                        zi)
             if opts.pallas:          # gate BEFORE importing pallas/mosaic
                 from ..ops import mailbox_kernel as mk
             if opts.pallas and (rows <= mk.LANE_BLOCK

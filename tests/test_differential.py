@@ -127,6 +127,9 @@ CONFIGS = [
                                max_sends=2, spill_cap=2048,
                                inject_slots=32, mesh_shards=4,
                                route_bucket=8, quiesce_interval=1)),
+    ("fused-kernel", dict(mailbox_cap=4, batch=2, msg_words=1,
+                          max_sends=2, spill_cap=512, inject_slots=16,
+                          pallas_fused=True)),
 ]
 
 

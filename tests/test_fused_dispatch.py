@@ -1,0 +1,84 @@
+"""Fused Pallas dispatch kernel (ops/fused_dispatch.py) equivalence vs
+the XLA path — same models, same counters/results, interpret mode on CPU
+(≙ exercising the north-star dispatch kernel the way genjit.cc runs
+compiled behaviour bodies in-process, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (F32, I32, Ref, Runtime, RuntimeOptions, actor,
+                       behaviour)
+
+
+def test_ubench_sustained_equivalence():
+    from ponyc_tpu.models import ubench
+    counts = {}
+    for fused in (False, True):
+        opts = RuntimeOptions(mailbox_cap=4, batch=4, max_sends=1,
+                              msg_words=1, spill_cap=256, inject_slots=8,
+                              pallas_fused=fused)
+        rt, ids = ubench.build(256, opts, pings=4)
+        ubench.seed_all(rt, ids, hops=1 << 30, pings=4)
+        st, inj = rt.state, rt._empty_inject
+        for _ in range(5):
+            st, aux = rt._step(st, *inj)
+        rt.state = st
+        counts[fused] = rt.counter("n_processed")
+    assert counts[True] == counts[False] == 5 * 256 * 4
+
+
+def test_nbody_float_vec_payloads_equivalence():
+    from ponyc_tpu.models import nbody
+    res = {}
+    for fused in (False, True):
+        rt = nbody.run_round(96, RuntimeOptions(
+            mailbox_cap=16, batch=4, max_sends=1, msg_words=4,
+            spill_cap=1024, pallas_fused=fused))
+        st = rt.cohort_state(nbody.Body)
+        res[fused] = (st["ax"].copy(), st["ay"].copy())
+    assert np.allclose(res[True][0], res[False][0], rtol=1e-6)
+    assert np.allclose(res[True][1], res[False][1], rtol=1e-6)
+
+
+@actor
+class Yielder:
+    n: I32
+
+    BATCH = 4
+    MAX_SENDS = 0
+
+    @behaviour
+    def tick(self, st, v: I32):
+        # yield after the first message of each batch (fork hint,
+        # actor.c:675-679): consumption must stop mid-batch identically.
+        self.yield_(when=st["n"] % 2 == 0)
+        return {**st, "n": st["n"] + 1}
+
+
+@actor
+class Exiter:
+    n: I32
+    MAX_SENDS = 0
+
+    @behaviour
+    def go(self, st, code: I32):
+        self.exit(code, when=code > 0)
+        return {**st, "n": st["n"] + 1}
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_yield_and_exit_semantics(fused):
+    opts = RuntimeOptions(mailbox_cap=8, batch=4, max_sends=0,
+                          msg_words=1, spill_cap=64, inject_slots=16,
+                          pallas_fused=fused)
+    rt = Runtime(opts)
+    rt.declare(Yielder, 2).declare(Exiter, 1).start()
+    y = rt.spawn(Yielder)
+    for _ in range(6):
+        rt.send(y, Yielder.tick, 1)
+    rt.run()
+    assert rt.state_of(y)["n"] == 6          # all consumed eventually
+
+    ex = rt.spawn(Exiter)
+    rt.send(ex, Exiter.go, 7)
+    assert rt.run() == 7                     # exit code propagates
