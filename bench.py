@@ -102,7 +102,9 @@ def bench_ubench(args):
     pings = args.pings
     cap = ubench.cap_for_pings(pings, floor=args.cap)
     opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
-                          msg_words=1, spill_cap=1024, inject_slots=8)
+                          msg_words=1, spill_cap=1024, inject_slots=8,
+                          delivery=args.delivery,
+                          pallas_fused=args.fused)
     t0 = time.time()
     rt, ids = ubench.build(args.actors, opts, pings=pings)
     ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)  # ~infinite
@@ -216,6 +218,13 @@ def main():
                     default=int(os.environ.get("PONY_TPU_BENCH_CAP", 4)))
     ap.add_argument("--pings", type=int,
                     default=int(os.environ.get("PONY_TPU_BENCH_PINGS", 4)))
+    ap.add_argument("--delivery",
+                    default=os.environ.get("PONY_TPU_BENCH_DELIVERY",
+                                           "plan"),
+                    choices=["plan", "cosort"])
+    ap.add_argument("--fused", action="store_true",
+                    default=os.environ.get("PONY_TPU_BENCH_FUSED",
+                                           "0") not in ("0", ""))
     ap.add_argument("--lat-actors", type=int, default=1024)
     ap.add_argument("--lat-ticks", type=int, default=200)
     ap.add_argument("--platform",
@@ -270,6 +279,8 @@ def main():
             "actors": args.actors,
             "ticks": ub["ticks"],
             "pings": ub["pings"],
+            "delivery": args.delivery,
+            "pallas_fused": args.fused,
             "fused_ticks_per_dispatch": ub["fuse"],
             "elapsed_s": round(ub["elapsed_s"], 4),
             "tick_ms": round(ub["tick_ms"], 3),
