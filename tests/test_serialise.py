@@ -111,3 +111,62 @@ def test_host_actor_state_round_trips(tmp_path):
     rt2.send(kid, Keeper.add, 3)
     rt2.run(max_steps=50)
     assert rt2.state_of(kid)["total"] == 8
+
+
+def test_snapshot_under_mute_pressure_resumes_to_oracle(tmp_path):
+    """Checkpoint taken MID-DEADLOCK-PRESSURE (muted senders, live spill,
+    aged mute counters) and restored into a fresh runtime must finish to
+    the exact oracle state — proving every backpressure column
+    (muted/mute_refs/mute_age/mute_ovf/pressured/spills/plan cache)
+    round-trips (≙ the serialise subsystem being the checkpoint/resume
+    building block, gc/serialise.c; SURVEY.md §5)."""
+    import sys as _sys
+    _sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    import numpy as np
+    import test_differential as td
+
+    from ponyc_tpu import Runtime, RuntimeOptions
+    from ponyc_tpu import serialise
+
+    n_w, n_s = 24, 8
+    w_nxt, s_w, s_s, seeds = td._case(23, n_w, n_s)   # the deadlock seed
+    want = td.oracle(n_w, n_s, w_nxt, s_w, s_s, seeds)
+
+    def build():
+        rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=1,
+                                    max_sends=2, spill_cap=512,
+                                    inject_slots=16))
+        rt.declare(td.Walker, n_w).declare(td.Splitter, n_s)
+        rt.start()
+        return rt
+
+    rt = build()
+    wids = rt.spawn_many(td.Walker, n_w)
+    sids = rt.spawn_many(td.Splitter, n_s)
+    rt.set_fields(td.Walker, wids, nxt=wids[np.asarray(w_nxt)])
+    rt.set_fields(td.Splitter, sids, w_ref=wids[np.asarray(s_w)],
+                  s_ref=sids[np.asarray(s_s)])
+    for kind, i, v in seeds:
+        rt.send(int(wids[i] if kind == "w" else sids[i]),
+                td.Walker.step if kind == "w" else td.Splitter.burst, v)
+    # run into the thick of it: mutes + spill live at snapshot time
+    inj = rt._drain_inject()
+    st, aux = rt._step(rt.state, *inj)
+    inj = rt._empty_inject
+    for _ in range(7):
+        st, aux = rt._step(st, *inj)
+    rt.state = st
+    assert np.asarray(st.muted).any(), "snapshot must land mid-pressure"
+    path = str(tmp_path / "mid_pressure.npz")
+    serialise.save(rt, path)
+
+    rt2 = build()                     # fresh runtime, same program
+    serialise.restore(rt2, path)
+    assert np.asarray(rt2.state.muted).any()
+    assert rt2.run(max_steps=50_000) == 0
+    wst = rt2.cohort_state(td.Walker)
+    sst = rt2.cohort_state(td.Splitter)
+    assert (wst["acc"].astype(np.int64) == want[0]).all()
+    assert (wst["hits"].astype(np.int64) == want[1]).all()
+    assert (sst["acc"].astype(np.int64) == want[2]).all()
+    assert not np.asarray(rt2.state.muted).any()
