@@ -1,0 +1,88 @@
+"""Host-API property fuzz: random op sequences against a live Runtime
+with queue/flag invariants checked throughout (≙ the reference's
+debug-build invariant checkers, actor.c:57-92 + messageq_size_debug,
+exercised here through the public host surface instead of C asserts)."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu import serialise
+from ponyc_tpu.stdlib import backpressure as bp
+
+
+@actor
+class Node:
+    acc: I32
+    peer: Ref["Node"]
+
+    MAX_SENDS = 1
+
+    @behaviour
+    def poke(self, st, v: I32):
+        self.send(st["peer"], Node.poke, v - 1, when=(v > 0)
+                  & (st["peer"] >= 0))
+        return {**st, "acc": st["acc"] + v}
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_random_host_op_sequences_keep_invariants(seed, tmp_path):
+    rng = np.random.default_rng(seed)
+    cap = 24
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=2, msg_words=1,
+                                max_sends=1, spill_cap=256,
+                                inject_slots=16, debug_checks=True))
+    rt.declare(Node, cap).start()
+    live = list(rt.spawn_many(Node, 8))
+    for a in live:
+        rt.set_fields(Node, np.asarray([a]),
+                      peer=np.asarray([int(rng.choice(live))]))
+    auth = bp.ApplyReleaseBackpressureAuth(rt.ambient_auth())
+    pressured = set()
+    sent = 0
+    for step in range(120):
+        op = rng.integers(0, 8)
+        if op == 0 and len(live) < cap:                 # spawn
+            a = rt.spawn(Node, peer=int(rng.choice(live)))
+            live.append(a)
+        elif op == 1:                                   # send
+            v = int(rng.integers(1, 9))
+            rt.send(int(rng.choice(live)), Node.poke, v)
+            sent += 1
+        elif op == 2:                                   # advance
+            rt.run(max_steps=int(rng.integers(1, 6)))
+        elif op == 3 and live:                          # pressure on/off
+            t = int(rng.choice(live))
+            if t in pressured:
+                bp.release(auth, t)
+                pressured.discard(t)
+            else:
+                bp.apply(auth, t)
+                pressured.add(t)
+        elif op == 4:                                   # gc
+            rt.gc()
+        elif op == 5 and len(live) > 4:                 # release a ref
+            t = live[int(rng.integers(0, len(live)))]
+            rt.release([t])
+            # released-but-referenced actors stay alive via peers; the
+            # id may still be messaged until collected — keep using it
+            # only if still alive after a gc
+            rt.gc()
+            if not bool(np.asarray(rt.state.alive)[t]):
+                live.remove(t)
+        elif op == 6:                                   # introspection
+            t = int(rng.choice(live))
+            assert rt.queue_depth(t) >= 0
+            rt.last_error(t)
+            rt.total_memory()
+        elif op == 7 and step % 40 == 20:               # checkpoint trip
+            p = str(tmp_path / f"fuzz_{seed}_{step}.npz")
+            serialise.save(rt, p)
+            serialise.restore(rt, p)
+        rt.check_invariants()
+    # quiesce fully: everything sent must be conserved into acc sums
+    for t in list(pressured):
+        bp.release(auth, t)
+    assert rt.run(max_steps=50_000) == 0
+    rt.check_invariants()
+    assert not np.asarray(rt.state.muted).any()
