@@ -121,11 +121,14 @@ def run_device(n_w, n_s, w_nxt, s_w, s_s, seeds, opts):
         else:
             rt.send(int(sids[i]), Splitter.burst, v)
     assert rt.run(max_steps=300_000) == 0, "must quiesce"
+    # Slot order == spawn order; a mesh rounds capacity up to a shard
+    # multiple, so slice to the actually-spawned rows.
     wst = rt.cohort_state(Walker)
     sst = rt.cohort_state(Splitter)
     assert not np.asarray(rt.state.muted).any(), "terminal world unmuted"
-    return (wst["acc"].astype(np.int64), wst["hits"].astype(np.int64),
-            sst["acc"].astype(np.int64))
+    return (wst["acc"][:n_w].astype(np.int64),
+            wst["hits"][:n_w].astype(np.int64),
+            sst["acc"][:n_s].astype(np.int64))
 
 
 def _case(seed, n_w=24, n_s=8, n_seeds=10, vmax=14):
@@ -202,6 +205,20 @@ def test_host_reporting_matches_oracle():
     wst = rt.cohort_state(WalkerH)
     assert (wst["acc"].astype(np.int64) == acc).all()
     assert rt.state_of(log)["ends"] == ends == len(starts)
+
+
+def test_uneven_cohorts_on_mesh_match_oracle():
+    """Cohort sizes NOT divisible by the shard count (capacity rounds up;
+    the padded rows must stay inert and slot-order reads must slice
+    clean)."""
+    n_w, n_s = 37, 11                  # neither divides 4
+    w_nxt, s_w, s_s, seeds = _case(51, n_w, n_s)
+    want = oracle(n_w, n_s, w_nxt, s_w, s_s, seeds)
+    got = run_device(n_w, n_s, w_nxt, s_w, s_s, seeds, RuntimeOptions(
+        mailbox_cap=2, batch=1, msg_words=1, max_sends=2, spill_cap=2048,
+        inject_slots=32, mesh_shards=4, quiesce_interval=2))
+    for g, w in zip(got, want):
+        assert (g == w).all()
 
 
 @pytest.mark.parametrize("name,okw", CONFIGS, ids=[c[0] for c in CONFIGS])
