@@ -250,3 +250,76 @@ def test_spawn_sync_rejects_effectful_constructor():
     rt.send(m, Maker3.make, 1)
     with pytest.raises(TypeError, match="effects"):
         rt.run(max_steps=4)
+
+
+def test_spawn_destroy_churn_conserves_against_oracle():
+    """Chain relays spawn ephemeral Workers that log and self-destroy:
+    spawn + destroy + messaging interacting under churn, with exact
+    conservation vs a closed-form oracle (≙ pony_create/destroy driven
+    from behaviour code at rate, actor.c:688-734, 570-664)."""
+    import numpy as np
+
+    from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, \
+        behaviour
+
+    @actor
+    class Relay:
+        nxt: Ref["Relay"]
+        sink: Ref["Collector"]
+        forwarded: I32
+
+        MAX_SENDS = 2
+        SPAWNS = {"Worker": 1}
+
+        @behaviour
+        def chain(self, st, v: I32):
+            w = self.spawn(Worker.init, v, st["sink"], when=v > 0)
+            self.send(st["nxt"], Relay.chain, v - 1, when=v > 0)
+            return {**st, "forwarded": st["forwarded"] + (w >= 0)}
+
+    @actor
+    class Worker:
+        MAX_SENDS = 1
+
+        @behaviour
+        def init(self, st, v: I32, sink: I32):
+            self.send(sink, Collector.log, v)
+            self.destroy()
+            return st
+
+    @actor
+    class Collector:
+        total: I32
+        hits: I32
+
+        BATCH = 8
+
+        @behaviour
+        def log(self, st, v: I32):
+            return {**st, "total": st["total"] + v,
+                    "hits": st["hits"] + 1}
+
+    for seed in (301, 307):
+        rng = np.random.default_rng(seed)
+        n_r = int(rng.integers(6, 16))
+        starts = [(int(rng.integers(0, n_r)), int(rng.integers(1, 10)))
+                  for _ in range(5)]
+        nxt = rng.integers(0, n_r, n_r)
+        total = sum(v - k for _, v in starts for k in range(v))
+        hits = sum(v for _, v in starts)
+        rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, msg_words=2,
+                                    max_sends=2, spill_cap=2048,
+                                    inject_slots=32, cd_interval=16))
+        rt.declare(Relay, n_r).declare(Worker, 4 * (hits + 8)).declare(
+            Collector, 1)
+        rt.start()
+        sink = rt.spawn(Collector)
+        rids = rt.spawn_many(Relay, n_r)
+        rt.set_fields(Relay, rids, nxt=rids[np.asarray(nxt)],
+                      sink=np.full(n_r, sink))
+        for i, v in starts:
+            rt.send(int(rids[i]), Relay.chain, v)
+        assert rt.run(max_steps=100_000) == 0
+        st = rt.state_of(sink)
+        assert st["total"] == total and st["hits"] == hits
+        assert rt.counter("n_destroyed") == hits
