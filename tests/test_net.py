@@ -180,3 +180,88 @@ def test_large_transfer_with_write_buffering():
     assert rt.state_of(sink)["total"] == len(blob)
     net.close_all()
     rt.stop()
+
+
+def test_tcp_connection_churn_conserves_bytes():
+    """Many concurrent loopback connections each echoing several chunks:
+    byte-exact conservation, all accepts seen, no payload-handle leaks
+    (≙ packages/net tests running listener+connection fleets under
+    ponytest)."""
+    import time
+
+    CHUNKS, N = 3, 12
+    MSG = b"x" * 700
+
+    @actor
+    class ChSrv:
+        HOST = True
+        n_conns: I32
+        n_bytes: I32
+
+        @behaviour
+        def on_accept(self, st, conn: I32):
+            return {**st, "n_conns": st["n_conns"] + 1}
+
+        @behaviour
+        def on_data(self, st, conn: I32, data: I32, n: I32):
+            payload = self.rt.heap.unbox(data)
+            self.rt.net.send(conn, payload)
+            return {**st, "n_bytes": st["n_bytes"] + n}
+
+        @behaviour
+        def on_closed(self, st, conn: I32):
+            return st
+
+    @actor
+    class ChCli:
+        HOST = True
+        conn: I32
+        got: I32
+        done: I32
+
+        @behaviour
+        def on_connect(self, st, conn: I32, err: I32):
+            assert err == 0, err
+            self.rt.net.send(conn, MSG)
+            return {**st, "conn": conn, "got": 0}
+
+        @behaviour
+        def on_data(self, st, conn: I32, data: I32, n: I32):
+            self.rt.heap.unbox(data)
+            got = st["got"] + n
+            if got >= len(MSG) * CHUNKS:
+                self.rt.net.close(conn)
+                return {**st, "got": got, "done": 1}
+            if got % len(MSG) == 0:
+                self.rt.net.send(conn, MSG)
+            return {**st, "got": got}
+
+        @behaviour
+        def on_closed(self, st, conn: I32):
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=32, batch=8, max_sends=2,
+                                msg_words=4, inject_slots=128))
+    rt.declare(ChSrv, 1).declare(ChCli, N)
+    rt.start()
+    net = rt.attach_net()
+    srv = rt.spawn(ChSrv)
+    lid = net.listen_tcp("127.0.0.1", 0, srv, on_accept=ChSrv.on_accept,
+                         on_data=ChSrv.on_data, on_closed=ChSrv.on_closed)
+    port = net.listen_port(lid)
+    clis = [rt.spawn(ChCli) for _ in range(N)]
+    for c in clis:
+        net.connect_tcp("127.0.0.1", port, c, on_connect=ChCli.on_connect,
+                        on_data=ChCli.on_data, on_closed=ChCli.on_closed)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rt.run(max_steps=200)
+        if sum(rt.state_of(c)["done"] for c in clis) == N:
+            break
+        time.sleep(0.01)
+    assert sum(rt.state_of(c)["done"] for c in clis) == N
+    assert rt.state_of(srv)["n_bytes"] == N * CHUNKS * len(MSG)
+    assert rt.state_of(srv)["n_conns"] == N
+    net.close_all()
+    rt.stop()
+    assert rt.heap.live == 0, rt.heap.live
