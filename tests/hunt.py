@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Extended differential bug hunt — the long-running version of
+tests/test_differential.py, run as a one-off (not under pytest):
+
+    python tests/hunt.py [n_seeds] [first_seed]
+
+Random world sizes and traffic per seed, rotating configurations
+(tiny-cap single chip, cosort, fused kernel, 4/8-shard meshes with tiny
+route buckets). Any mismatch against the sequential oracle or failure to
+quiesce prints FAIL lines and exits nonzero. The round-3 campaign ran
+30 single-chip + 12 mesh seeds clean after fixing the mute-cycle
+deadlock this harness found (ROUND3_NOTES.md)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ponyc_tpu.platforms import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from ponyc_tpu import RuntimeOptions  # noqa: E402
+import test_differential as td  # noqa: E402
+
+CONFIGS = {
+    "tiny": dict(mailbox_cap=2, batch=1, msg_words=1, max_sends=2,
+                 spill_cap=2048, inject_slots=16),
+    "cosort": dict(mailbox_cap=4, batch=2, msg_words=1, max_sends=2,
+                   spill_cap=2048, inject_slots=16, delivery="cosort"),
+    "fused": dict(mailbox_cap=4, batch=2, msg_words=1, max_sends=2,
+                  spill_cap=2048, inject_slots=16, pallas_fused=True),
+    "mesh4": dict(mailbox_cap=2, batch=1, msg_words=1, max_sends=2,
+                  spill_cap=4096, inject_slots=64, mesh_shards=4,
+                  quiesce_interval=2),
+    "mesh8-bucket": dict(mailbox_cap=4, batch=2, msg_words=1,
+                         max_sends=2, spill_cap=4096, inject_slots=64,
+                         mesh_shards=8, route_bucket=8,
+                         quiesce_interval=1),
+}
+
+
+def main():
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    first = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    fails = []
+    t0 = time.time()
+    names = list(CONFIGS)
+    for n, seed in enumerate(range(first, first + n_seeds)):
+        rng = np.random.default_rng(seed)
+        n_w = int(rng.integers(12, 80))
+        n_s = int(rng.integers(4, 24))
+        w_nxt, s_w, s_s, seeds = td._case(seed, n_w, n_s,
+                                          n_seeds=12, vmax=16)
+        want = td.oracle(n_w, n_s, w_nxt, s_w, s_s, seeds)
+        cfg = names[n % len(names)]
+        try:
+            got = td.run_device(n_w, n_s, w_nxt, s_w, s_s, seeds,
+                                RuntimeOptions(**CONFIGS[cfg]))
+            if not all((g == w).all() for g, w in zip(got, want)):
+                fails.append((seed, cfg, "MISMATCH"))
+        except Exception as e:                  # noqa: BLE001
+            fails.append((seed, cfg, repr(e)[:160]))
+        print(f"seed {seed} ({cfg}, n_w={n_w}, n_s={n_s}): "
+              f"{'FAIL' if fails and fails[-1][0] == seed else 'ok'}",
+              flush=True)
+    print(f"\n{n_seeds - len(fails)}/{n_seeds} ok "
+          f"in {time.time() - t0:.0f}s")
+    for f in fails:
+        print("FAIL:", f)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
