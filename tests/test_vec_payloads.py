@@ -140,3 +140,59 @@ def test_constant_vec_beside_lane_varying_arg():
     rt.send(int(a), T.go, [0.0, 0.0], 2)
     assert rt.run(max_steps=8) == 0
     assert rt.state_of(int(b))["n"] == 1      # got the forwarded hop
+
+
+def test_vec_payloads_survive_spill_and_retry():
+    """VecF32 messages forced through the rejection spill (cap-2 sink,
+    16 flooding sources) re-deliver bit-exactly — the spill stores raw
+    words, so float payload integrity is end-to-end (≙ rich message
+    payloads surviving queue pressure, pony_alloc_msg + messageq)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ponyc_tpu import (F32, I32, Ref, Runtime, RuntimeOptions,
+                           VecF32, actor, behaviour)
+
+    @actor
+    class VSink:
+        sx: F32
+        sy: F32
+        n: I32
+        BATCH = 1
+
+        @behaviour
+        def take(self, st, v: VecF32[3], scale: F32):
+            return {**st, "sx": st["sx"] + v[0] * scale,
+                    "sy": st["sy"] + v[1] + v[2], "n": st["n"] + 1}
+
+    @actor
+    class VSrc:
+        out: Ref[VSink]
+        left: I32
+        MAX_SENDS = 2
+
+        @behaviour
+        def go(self, st, _: I32):
+            alive = st["left"] > 0
+            k = st["left"].astype("float32")
+            self.send(st["out"], VSink.take,
+                      jnp.stack([k, k * 0.5, -k]), 2.0, when=alive)
+            self.send(self.actor_id, VSrc.go, 0, when=st["left"] > 1)
+            return {**st, "left": st["left"] - 1}
+
+    n_src, items = 16, 25
+    rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=4,
+                                max_sends=2, spill_cap=512,
+                                inject_slots=32))
+    rt.declare(VSrc, n_src).declare(VSink, 1).start()
+    sink = rt.spawn(VSink)
+    srcs = rt.spawn_many(VSrc, n_src, out=sink, left=items)
+    rt.bulk_send(srcs, VSrc.go, np.zeros(n_src, np.int64))
+    assert rt.run(max_steps=60_000) == 0
+    st = rt.state_of(sink)
+    want_sx = n_src * 2.0 * sum(range(1, items + 1))
+    want_sy = n_src * sum(k * 0.5 - k for k in range(1, items + 1))
+    assert st["n"] == n_src * items
+    assert abs(st["sx"] - want_sx) < 1e-3
+    assert abs(st["sy"] - want_sy) < 1e-3
+    assert rt.counter("n_rejected") > 0, "spill path must engage"
