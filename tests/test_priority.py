@@ -49,10 +49,14 @@ class Rx:
                 "first4": st["first4"] + jnp.where(first, v, 0)}
 
 
-def test_higher_priority_wins_contended_slots():
+import pytest
+
+
+@pytest.mark.parametrize("mode", ["plan", "cosort"])
+def test_higher_priority_wins_contended_slots(mode):
     rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=4, max_sends=4,
                                 msg_words=2, spill_cap=64,
-                                inject_slots=8))
+                                inject_slots=8, delivery=mode))
     rt.declare(HiSender, 1).declare(LoSender, 1).declare(Rx, 1)
     rt.start()
     rx = rt.spawn(Rx)
