@@ -232,3 +232,92 @@ def test_device_matches_oracle(name, okw, seed):
     for g, w, what in zip(got, want, ("w_acc", "w_hits", "s_acc")):
         assert (g == w).all(), (
             name, seed, what, np.nonzero(g != w)[0][:5], g.sum(), w.sum())
+
+
+def test_multi_behaviour_dispatch_matches_oracle():
+    """Three behaviours of different arities on one type under random
+    traffic: per-lane behaviour-id selection across batch slots (the
+    lax.switch-equivalent path the single-behaviour configs never
+    exercise). Commutative outputs compared exactly; acc only for
+    actors untouched by the non-commutative behaviour."""
+    from collections import deque
+
+    @actor
+    class Tri:
+        acc: I32
+        count: I32
+        nxt: Ref["Tri"]
+
+        MAX_SENDS = 2
+
+        @behaviour
+        def add(self, st, v: I32):
+            self.send(st["nxt"], Tri.add, v - 2, when=v > 2)
+            return {**st, "acc": st["acc"] + v,
+                    "count": st["count"] + 1}
+
+        @behaviour
+        def mul2_then_ping(self, st, v: I32, flag: I32):
+            self.send(st["nxt"], Tri.ping, when=flag > 0)
+            return {**st, "acc": st["acc"] * 2 + v,
+                    "count": st["count"] + 1}
+
+        @behaviour
+        def ping(self, st):
+            return {**st, "count": st["count"] + 1}
+
+    def oracle(n, nxt, seeds):
+        acc = np.zeros(n, np.int64)
+        cnt = np.zeros(n, np.int64)
+        q = deque(seeds)
+        while q:
+            op, i, args = q.popleft()
+            if op == "add":
+                v, = args
+                acc[i] += v
+                cnt[i] += 1
+                if v > 2:
+                    q.append(("add", int(nxt[i]), (v - 2,)))
+            elif op == "mul":
+                v, flag = args
+                acc[i] = acc[i] * 2 + v
+                cnt[i] += 1
+                if flag > 0:
+                    q.append(("ping", int(nxt[i]), ()))
+            else:
+                cnt[i] += 1
+        return acc, cnt
+
+    for seed, mode in ((501, "plan"), (506, "cosort")):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(10, 40))
+        nxt = rng.integers(0, n, n)
+        seeds = []
+        for _ in range(10):
+            r = rng.random()
+            i = int(rng.integers(0, n))
+            if r < 0.5:
+                seeds.append(("add", i, (int(rng.integers(1, 12)),)))
+            elif r < 0.85:
+                seeds.append(("mul", i, (int(rng.integers(0, 5)),
+                                         int(rng.integers(0, 2)))))
+            else:
+                seeds.append(("ping", i, ()))
+        want_acc, want_cnt = oracle(n, nxt, seeds)
+        mul_targets = {i for op, i, _ in seeds if op == "mul"}
+        rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=2,
+                                    max_sends=2, spill_cap=1024,
+                                    inject_slots=16, delivery=mode))
+        rt.declare(Tri, n).start()
+        ids = rt.spawn_many(Tri, n)
+        rt.set_fields(Tri, ids, nxt=ids[np.asarray(nxt)])
+        for op, i, args in seeds:
+            b = {"add": Tri.add, "mul": Tri.mul2_then_ping,
+                 "ping": Tri.ping}[op]
+            rt.send(int(ids[i]), b, *args)
+        assert rt.run(max_steps=100_000) == 0
+        st = rt.cohort_state(Tri)
+        assert (st["count"][:n].astype(np.int64) == want_cnt).all()
+        for i in range(n):
+            if i not in mul_targets:
+                assert int(st["acc"][i]) == int(want_acc[i])
