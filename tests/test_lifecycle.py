@@ -323,3 +323,72 @@ def test_spawn_destroy_churn_conserves_against_oracle():
         st = rt.state_of(sink)
         assert st["total"] == total and st["hits"] == hits
         assert rt.counter("n_destroyed") == hits
+
+
+def test_spawn_destroy_churn_on_mesh():
+    """The churn scenario sharded over 4 devices: same-shard spawn slots,
+    cross-shard constructor/report messages, exact conservation."""
+    import numpy as np
+
+    from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, \
+        behaviour
+
+    @actor
+    class MRelay:
+        nxt: Ref["MRelay"]
+        sink: Ref["MCollector"]
+
+        MAX_SENDS = 2
+        SPAWNS = {"MWorker": 1}
+
+        @behaviour
+        def chain(self, st, v: I32):
+            self.spawn(MWorker.init, v, st["sink"], when=v > 0)
+            self.send(st["nxt"], MRelay.chain, v - 1, when=v > 0)
+            return st
+
+    @actor
+    class MWorker:
+        MAX_SENDS = 1
+
+        @behaviour
+        def init(self, st, v: I32, sink: I32):
+            self.send(sink, MCollector.log, v)
+            self.destroy()
+            return st
+
+    @actor
+    class MCollector:
+        total: I32
+        hits: I32
+
+        BATCH = 16
+
+        @behaviour
+        def log(self, st, v: I32):
+            return {**st, "total": st["total"] + v,
+                    "hits": st["hits"] + 1}
+
+    rng = np.random.default_rng(5)
+    n_r = 16
+    starts = [(int(rng.integers(0, n_r)), int(rng.integers(4, 12)))
+              for _ in range(8)]
+    nxt = rng.integers(0, n_r, n_r)
+    total = sum(v - k for _, v in starts for k in range(v))
+    hits = sum(v for _, v in starts)
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, msg_words=2,
+                                max_sends=2, spill_cap=4096,
+                                inject_slots=64, mesh_shards=4,
+                                quiesce_interval=2, cd_interval=16))
+    rt.declare(MRelay, n_r).declare(MWorker, 512).declare(MCollector, 4)
+    rt.start()
+    sink = rt.spawn(MCollector)
+    rids = rt.spawn_many(MRelay, n_r)
+    rt.set_fields(MRelay, rids, nxt=rids[np.asarray(nxt)],
+                  sink=np.full(n_r, sink))
+    for i, v in starts:
+        rt.send(int(rids[i]), MRelay.chain, v)
+    assert rt.run(max_steps=100_000) == 0
+    st = rt.state_of(sink)
+    assert st["total"] == total and st["hits"] == hits
+    assert rt.counter("n_spawned") == rt.counter("n_destroyed") == hits
