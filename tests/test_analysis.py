@@ -144,3 +144,50 @@ def test_level3_per_event_rows(tmp_path):
                  "OVERLOAD"):
         assert want in events, (want, sorted(set(events)))
     assert rt.state_of(int(sink))["total"] == 8 * 6
+
+
+def test_level3_ring_overflow_counts_drops(tmp_path):
+    """A deliberately tiny event ring under mute churn records what fits
+    and COUNTS the rest (ev_dropped) instead of silently truncating
+    (≙ the fork's analysis queue never silently losing events)."""
+    import numpy as np
+
+    from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, \
+        behaviour
+
+    @actor
+    class SlowE:
+        n: I32
+        BATCH = 1
+
+        @behaviour
+        def eat(self, st, v: I32):
+            return {**st, "n": st["n"] + 1}
+
+    @actor
+    class FastE:
+        out: Ref[SlowE]
+        left: I32
+        MAX_SENDS = 2
+
+        @behaviour
+        def go(self, st, _: I32):
+            self.send(st["out"], SlowE.eat, 1, when=st["left"] > 0)
+            self.send(self.actor_id, FastE.go, 0, when=st["left"] > 1)
+            return {**st, "left": st["left"] - 1}
+
+    path = str(tmp_path / "ev.csv")
+    rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=1,
+                                max_sends=2, spill_cap=512,
+                                inject_slots=16, analysis=3,
+                                analysis_events=8, analysis_path=path))
+    rt.declare(FastE, 12).declare(SlowE, 1).start()
+    s = rt.spawn(SlowE)
+    fs = rt.spawn_many(FastE, 12, out=s, left=30)
+    rt.bulk_send(fs, FastE.go, np.zeros(12, np.int64))
+    assert rt.run(max_steps=30_000) == 0
+    rt.stop()
+    import os
+    rows = open(path + ".events.csv").read().strip().splitlines()
+    assert len(rows) > 1, "events must be recorded"
+    assert int(rt.state.ev_dropped[0]) > 0, "tiny ring must count drops"
