@@ -17,7 +17,6 @@ reports mean/p50/p95 per call plus derived ops/s.
 from __future__ import annotations
 
 import json
-import statistics
 import sys
 import time
 from typing import Any, Callable, Dict, List, Optional

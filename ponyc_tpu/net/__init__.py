@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import collections
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from .. import native
 from ..api import BehaviourDef

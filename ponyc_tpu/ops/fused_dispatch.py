@@ -39,7 +39,6 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from . import pack
 
 LANE_BLOCK = 1024
 

@@ -40,7 +40,7 @@ Pony's type system makes unrepresentable; here it is a counted drop.
 
 from __future__ import annotations
 
-from typing import Dict
+
 
 import jax
 import jax.numpy as jnp

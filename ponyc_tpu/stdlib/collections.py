@@ -14,7 +14,7 @@ iterator. Persistent (immutable) variants live in stdlib.persistent.
 from __future__ import annotations
 
 import math as _math
-from typing import Any, Generic, Iterable, Iterator, List as _List, \
+from typing import Generic, Iterable, Iterator, List as _List, \
     Optional, Sequence, TypeVar
 
 __all__ = ["Flags", "Range", "MinHeap", "MaxHeap", "BinaryHeap",

@@ -23,7 +23,6 @@ test with a watchdog timeout — the same structure, host-side.
 from __future__ import annotations
 
 import fnmatch
-import io
 import sys
 import threading
 import time
