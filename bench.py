@@ -164,7 +164,9 @@ def bench_latency(args):
     from ponyc_tpu.models import ring
 
     opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
-                          spill_cap=64, inject_slots=8)
+                          spill_cap=64, inject_slots=8,
+                          delivery=args.delivery,
+                          pallas_fused=args.fused)
     rt, ids = ring.build(args.lat_actors, opts)
     rt.send(int(ids[0]), ring.RingNode.token, 1 << 30)
     inj = rt._drain_inject()
