@@ -106,3 +106,39 @@ def test_runtime_defaults_override():
                                  batch=1, max_sends=1))
     rt2.declare(Tuned, 2).start()
     assert rt2.opts.mailbox_cap == 8      # explicit options win
+
+
+def test_inject_flood_conserves_through_bounded_slots():
+    """Thousands of queued host sends drain through the bounded
+    per-step inject slots with per-target flow control, exactly once
+    (≙ external pony_sendv bursts through the scheduler inject queue,
+    actor.c:773 from non-actor context)."""
+    import numpy as np
+
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+    @actor
+    class FloodCnt:
+        n: I32
+        s: I32
+        BATCH = 2
+
+        @behaviour
+        def hit(self, st, v: I32):
+            return {**st, "n": st["n"] + 1, "s": st["s"] + v}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=2, msg_words=1,
+                                max_sends=1, spill_cap=64,
+                                inject_slots=8))
+    rt.declare(FloodCnt, 4).start()
+    ids = rt.spawn_many(FloodCnt, 4)
+    rng = np.random.default_rng(0)
+    total = 0
+    for _ in range(2000):
+        v = int(rng.integers(1, 7))
+        rt.send(int(ids[rng.integers(0, 4)]), FloodCnt.hit, v)
+        total += v
+    assert rt.run(max_steps=50_000) == 0
+    st = rt.cohort_state(FloodCnt)
+    assert int(st["n"].sum()) == 2000
+    assert int(st["s"].sum()) == total
