@@ -15,9 +15,10 @@ scan intermediates.
 
 Eligibility (checked by `eligible()` — everything else falls back to
 the XLA path, same semantics):
-  - single-behaviour cohort (the dispatch select degenerates);
-  - no device spawns, no destroy, no error_int, no sync-construction
-    (those effects need the engine's reservation/row bookkeeping);
+  - no device spawns/destroy/error/sync-construction across the
+    cohort's behaviours (multi-behaviour cohorts are fine: the kernel
+    evaluates every behaviour on the lanes and selects per lane by
+    message id, exactly like the XLA scan);
   - behaviour body uses only elementwise/lane ops. This is the API
     contract anyway — a behaviour describes ONE actor's reaction, so
     lane-crossing ops (reductions over the cohort) have no defined
@@ -45,7 +46,7 @@ LANE_BLOCK = 1024
 
 def eligible(cohort, effects, opts) -> bool:
     """Structural + trace-discovered preconditions for the fused path."""
-    return (len(cohort.behaviours) == 1
+    return (len(cohort.behaviours) >= 1
             and not cohort.spawns
             and not effects["destroy"]
             and not effects["error"]
@@ -74,7 +75,7 @@ def _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms, lanes):
     return branch
 
 
-def build_fused_dispatch(bdef, *, base_gid: int, field_names: Sequence[str],
+def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
                          field_dtypes, field_specs, batch: int, cap: int,
                          msg_words: int, ms: int, rows: int,
                          noyield: bool, interpret: bool):
@@ -87,8 +88,9 @@ def build_fused_dispatch(bdef, *, base_gid: int, field_names: Sequence[str],
     lb = min(LANE_BLOCK, rows)
     assert rows % lb == 0, (rows, lb)
     nf = len(field_names)
-    branch = _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms,
-                          lb)
+    branches = [_slim_branch(b, field_specs, field_dtypes, msg_words, ms,
+                             lb) for b in bdefs]
+    nb = len(branches)
 
     def kernel(head_ref, nrun_ref, ids_ref, *refs):
         field_refs = refs[:nf]
@@ -119,23 +121,34 @@ def build_fused_dispatch(bdef, *, base_gid: int, field_names: Sequence[str],
                 msg = jnp.where((slot == c)[None, :], buf_ref[c], msg)
             valid = (nrun > k)
             do_any = valid & ~stopped
-            in_range = msg[0] == base_gid        # single behaviour
+            local = msg[0] - base_gid
+            in_range = (local >= 0) & (local < nb)
             do = do_any & in_range
-            st2, tgts, words, bef, bec, byf = branch(st, msg[1:], ids)
-            for i, name in enumerate(field_names):
-                st[name] = jnp.where(do, st2[name], st[name])
+            # Evaluate every behaviour on the lanes, select per lane by
+            # its message id — the same planar select the XLA scan does.
+            acc_tgt = [jnp.full((lb,), -1, jnp.int32)
+                       for _ in range(ms)]
+            acc_words = [jnp.zeros((w1, lb), jnp.int32)
+                         for _ in range(ms)]
+            for j, branch in enumerate(branches):
+                take = do & (local == j)
+                st2, tgts, words, bef, bec, byf = branch(st, msg[1:],
+                                                         ids)
+                for i, name in enumerate(field_names):
+                    st[name] = jnp.where(take, st2[name], st[name])
+                for m in range(ms):
+                    acc_tgt[m] = jnp.where(take, tgts[m], acc_tgt[m])
+                    acc_words[m] = jnp.where(take[None, :], words[m],
+                                             acc_words[m])
+                new_ef = take & bef
+                ec = jnp.where(new_ef & ~ef, bec, ec)
+                ef = ef | new_ef
+                if not noyield:
+                    stopped = stopped | (take & byf)
             for m in range(ms):
-                tgt_ref[k * ms + m] = jnp.where(do, tgts[m],
-                                                jnp.int32(-1))
+                tgt_ref[k * ms + m] = acc_tgt[m]
                 for w in range(w1):
-                    words_ref[(k * ms + m) * w1 + w] = jnp.where(
-                        do, words[m][w], jnp.int32(0))
-            del tgts, words
-            new_ef = do & bef
-            ec = jnp.where(new_ef & ~ef, bec, ec)
-            ef = ef | new_ef
-            if not noyield:
-                stopped = stopped | (do & byf)
+                    words_ref[(k * ms + m) * w1 + w] = acc_words[m][w]
             nproc = nproc + do.astype(jnp.int32)
             nbad = nbad + (do_any & ~in_range).astype(jnp.int32)
             consumed = consumed + do_any.astype(jnp.int32)

@@ -265,24 +265,25 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     base = cohort.behaviours[0].global_id if nb else 0
     sd = cohort.spawn_dispatches
     fused = None
-    if opts.pallas_fused and nb == 1 and not cohort.spawns:
+    if opts.pallas_fused and nb >= 1 and not cohort.spawns:
         from ..ops import fused_dispatch as fd
         from ..ops import mailbox_kernel as mk
-    if (opts.pallas_fused and nb == 1 and not cohort.spawns
+    if (opts.pallas_fused and nb >= 1 and not cohort.spawns
             and (rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0)):
-        # Probe-trace the branch so `effects` is discovered BEFORE the
+        # Probe-trace every branch so `effects` is discovered BEFORE the
         # path decision (the fused kernel cannot host destroy/error/
         # sync-construction bookkeeping).
-        jax.eval_shape(
-            branches[0],
-            {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
-             for f in cohort.atype.field_specs},
-            jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
-            jax.ShapeDtypeStruct((rows,), jnp.int32), {})
+        for br in branches:
+            jax.eval_shape(
+                br,
+                {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
+                 for f in cohort.atype.field_specs},
+                jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
+                jax.ShapeDtypeStruct((rows,), jnp.int32), {})
         if fd.eligible(cohort, effects, opts):
             fnames = tuple(cohort.atype.field_specs.keys())
             fused = (fd.build_fused_dispatch(
-                cohort.behaviours[0], base_gid=base,
+                cohort.behaviours, base_gid=base,
                 field_names=fnames, field_dtypes=field_dtypes,
                 field_specs=cohort.atype.field_specs, batch=batch,
                 cap=cap, msg_words=msg_words, ms=ms, rows=rows,

@@ -82,3 +82,55 @@ def test_yield_and_exit_semantics(fused):
     ex = rt.spawn(Exiter)
     rt.send(ex, Exiter.go, 7)
     assert rt.run() == 7                     # exit code propagates
+
+
+def test_multi_behaviour_cohort_under_fused_kernel():
+    """nb > 1: the kernel evaluates every behaviour on the lanes and
+    selects per lane by message id — results equal the XLA path on a
+    mixed add/mul/ping workload."""
+    @actor
+    class TriF:
+        acc: I32
+        count: I32
+        buddy: Ref["TriF"]
+        MAX_SENDS = 1
+
+        @behaviour
+        def add(self, st, v: I32):
+            # a SENDING behaviour among non-senders: the nb>1 per-branch
+            # send-plane select must route only add's sends
+            self.send(st["buddy"], TriF.ping, when=v % 2 == 1)
+            return {**st, "acc": st["acc"] + v,
+                    "count": st["count"] + 1}
+
+        @behaviour
+        def scale(self, st, v: I32):
+            return {**st, "acc": st["acc"] * 2 + v,
+                    "count": st["count"] + 1}
+
+        @behaviour
+        def ping(self, st):
+            return {**st, "count": st["count"] + 1}
+
+    res = {}
+    for fused in (False, True):
+        rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=4, max_sends=1,
+                                    msg_words=1, spill_cap=64,
+                                    inject_slots=32,
+                                    pallas_fused=fused))
+        rt.declare(TriF, 3).start()
+        ids = rt.spawn_many(TriF, 3)
+        import numpy as _np
+        rt.set_fields(TriF, ids, buddy=_np.roll(ids, -1))
+        seq = [(0, TriF.add, (5,)), (1, TriF.scale, (3,)),
+               (0, TriF.ping, ()), (2, TriF.add, (7,)),
+               (1, TriF.add, (2,)), (0, TriF.scale, (1,)),
+               (2, TriF.ping, ()), (1, TriF.ping, ())]
+        for i, b, args in seq:
+            rt.send(int(ids[i]), b, *args)
+        assert rt.run() == 0
+        st = rt.cohort_state(TriF)
+        res[fused] = (list(st["acc"][:3]), list(st["count"][:3]))
+    assert res[True] == res[False]
+    # adds with odd v (5 at actor0, 7 at actor2) ping their buddies
+    assert res[True][1] == [4, 4, 2]
