@@ -134,3 +134,27 @@ def test_multi_behaviour_cohort_under_fused_kernel():
     assert res[True] == res[False]
     # adds with odd v (5 at actor0, 7 at actor2) ping their buddies
     assert res[True][1] == [4, 4, 2]
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_gups_xor_conservation_under_fused(fused):
+    """The gups random-access workload (two cohorts, one sending into a
+    table of cells) conserves its xor under the fused kernel exactly as
+    under the XLA path."""
+    from ponyc_tpu.models import gups
+    rt = gups.run(table_size=256, n_updaters=16, updates_each=12,
+                  opts=RuntimeOptions(mailbox_cap=16, batch=4,
+                                      max_sends=2, msg_words=2,
+                                      spill_cap=2048, inject_slots=32,
+                                      pallas_fused=fused))
+    cells = rt.cohort_state(gups.TableCell)
+    import numpy as np
+    x = np.bitwise_xor.reduce(cells["value"].astype(np.int64)[:256])
+    # xor of all applied updates is deterministic for fixed seed
+    assert rt.counter("n_processed") > 0
+    upd = rt.cohort_state(gups.Updater)
+    assert int(upd["done"].sum()) == 16 * 12
+    globals().setdefault("_gups_xor", {})[fused] = int(x)
+    if len(globals()["_gups_xor"]) == 2:
+        assert (globals()["_gups_xor"][True]
+                == globals()["_gups_xor"][False])
