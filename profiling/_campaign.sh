@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry the measurement campaign until it completes OR the deadline
+# passes (so an orphaned campaign can never contend with the driver's
+# end-of-round bench run for the single TPU claim).
+DEADLINE=${CAMPAIGN_DEADLINE:-$(date -d '2026-07-30 15:30 UTC' +%s)}
+for i in $(seq 1 300); do
+  [ "$(date +%s)" -ge "$DEADLINE" ] && { echo "[$(date +%H:%M:%S)] deadline reached, stopping" >> /tmp/p9_campaign.log; break; }
+  grep -q "ALL_DONE" /tmp/p9_results.txt 2>/dev/null && break
+  echo "[$(date +%H:%M:%S)] attempt $i" >> /tmp/p9_campaign.log
+  python -u /root/repo/profiling/_profile_all.py >> /tmp/p9_all.log 2>&1
+  echo "[$(date +%H:%M:%S)] attempt $i exited rc=$?" >> /tmp/p9_campaign.log
+  grep -q "ALL_DONE" /tmp/p9_results.txt 2>/dev/null && break
+  sleep 120
+done
+echo "[$(date +%H:%M:%S)] campaign loop ended" >> /tmp/p9_campaign.log
