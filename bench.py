@@ -23,7 +23,8 @@ Platform handling (round-2 fix): the TPU backend behind the axon tunnel
 can fail or hang on init, and the plugin re-asserts itself over
 JAX_PLATFORMS. The backend is therefore probed in a *subprocess* with a
 timeout (a hung in-process jax.devices() would wedge this process's
-backend lock forever), retried, and on persistent failure the bench falls
+backend lock forever), retried against a total time budget (--probe-budget, default 900s), and on
+persistent failure the bench falls
 back to CPU — loudly, with the TPU error in the JSON detail — so a run
 always captures a parseable result. Set PONY_TPU_BENCH_ALLOW_CPU=0 to
 make TPU-init failure fatal instead, or --platform cpu for smoke runs.
@@ -37,7 +38,6 @@ import argparse
 import json
 import os
 import statistics
-import subprocess
 import sys
 import time
 
@@ -45,44 +45,43 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 CPU32_BASELINE_MSGS_PER_SEC = 3.0e8
 
-_PROBE_SRC = "import jax; d = jax.devices(); print('PLAT:' + d[0].platform)"
 
+def probe_tpu(timeout_s: float, budget_s: float):
+    """Claim-retry queue: keep probing the TPU (subprocess + timeout,
+    ponyc_tpu.platforms.probe_accelerator) until it answers or a total
+    time budget runs out, so a transiently-wedged tunnel yields a LATE
+    TPU number rather than none (round-3 lesson: one 3×180s probe window
+    erased the round's on-chip headline metric; observed wedges clear
+    after tens of minutes).
 
-def probe_tpu(timeout_s: float, retries: int):
-    """Initialise JAX in a subprocess and report the default platform.
-
-    Returns (platform_or_None, last_error). A hung init (observed: the
-    axon backend blocking >10 min) only costs the subprocess."""
+    Returns (platform_or_None, last_error)."""
+    from ponyc_tpu.platforms import probe_accelerator
+    deadline = time.monotonic() + budget_s
     err = None
-    for attempt in range(1, retries + 1):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout_s)
-            out = r.stdout or ""
-            plat = None
-            for line in out.splitlines():
-                if line.startswith("PLAT:"):
-                    plat = line[5:].strip()
-            if r.returncode == 0 and plat and plat != "cpu":
-                return plat, None
-            if r.returncode == 0:
-                # Deterministic outcome — JAX resolved to CPU; retrying
-                # would just re-init the same backend.
-                err = f"backend initialised as {plat!r}, not a TPU"
-                print(f"bench: TPU probe: {err}", file=sys.stderr)
-                return None, err
-            else:
-                err = (r.stderr or out).strip()[-1500:] or \
-                    f"probe exited rc={r.returncode}"
-        except subprocess.TimeoutExpired:
-            err = (f"jax.devices() did not return within {timeout_s:.0f}s "
-                   "(backend init hang)")
-        print(f"bench: TPU probe attempt {attempt}/{retries} failed: {err}",
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 5.0:
+            return None, err or "probe budget exhausted"
+        # First attempt: the configured timeout. Later attempts wait as
+        # long as the budget allows (a claim that queues for minutes and
+        # then succeeds beats five fast kills — killing a claim-waiting
+        # client has been observed to re-wedge the tunnel).
+        t = min(remaining, timeout_s if attempt == 1 else max(
+            timeout_s, 300.0))
+        plat, err = probe_accelerator(t)
+        if plat is not None:
+            return plat, None
+        if err and err.startswith("backend initialised as"):
+            # Deterministic outcome — JAX resolved to CPU; retrying
+            # would just re-init the same backend.
+            print(f"bench: TPU probe: {err}", file=sys.stderr)
+            return None, err
+        print(f"bench: TPU probe attempt {attempt} failed "
+              f"({remaining - t:.0f}s of budget left): {err}",
               file=sys.stderr)
-        if attempt < retries:
-            time.sleep(5.0)
-    return None, err
+        time.sleep(min(10.0, max(0.0, deadline - time.monotonic())))
 
 
 def force_cpu():
@@ -236,7 +235,9 @@ def main():
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get(
                         "PONY_TPU_BENCH_PROBE_TIMEOUT", 180.0)))
-    ap.add_argument("--probe-retries", type=int, default=3)
+    ap.add_argument("--probe-budget", type=float,
+                    default=float(os.environ.get(
+                        "PONY_TPU_BENCH_PROBE_BUDGET", 900.0)))
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
@@ -246,7 +247,7 @@ def main():
     if args.platform == "cpu":
         force_cpu()
     elif args.platform == "auto":
-        plat, tpu_error = probe_tpu(args.probe_timeout, args.probe_retries)
+        plat, tpu_error = probe_tpu(args.probe_timeout, args.probe_budget)
         if plat is None:
             if not allow_cpu:
                 print(json.dumps({"error": "tpu_init_failed",

@@ -8,6 +8,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.platforms import auto_backend  # noqa: E402
 
 
 @actor
@@ -39,6 +40,7 @@ class Reporter:
 
 
 def main():
+    auto_backend()      # never hang on a wedged TPU plugin
     n, incs = 8, 100
     rt = Runtime(RuntimeOptions(msg_words=2, inject_slots=256,
                                 batch=16))

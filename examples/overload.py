@@ -12,6 +12,9 @@ import numpy as np  # noqa: E402
 
 from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor,  # noqa
                        behaviour)
+from ponyc_tpu.platforms import auto_backend  # noqa: E402
+
+auto_backend()      # never hang on a wedged TPU plugin
 
 N_SENDERS, ITEMS = 64, 50
 

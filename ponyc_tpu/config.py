@@ -55,7 +55,12 @@ class RuntimeOptions:
     #   sender is force-released (the lockstep deadlock-breaker for
     #   mutual-mute cycles/chains — see state.mute_age; short enough to
     #   bound stall time, long enough that ordinary backpressure mutes
-    #   release via recovery, not aging)
+    #   release via recovery, not aging). Aging only fires when every
+    #   congested muter is itself muted/dead (a true deadlock); a live
+    #   runnable muter with queue/spill evidence holds the mute.
+    #   <= 0 disables aging entirely (exact reference mute semantics,
+    #   which can deadlock on mutual-mute cycles — documented
+    #   divergence opt-out).
     mute_slots: int = 4            # muting-receiver refs tracked per sender
     #   (≙ mutemap.c's receiver-set + actor.h mute counters: unmute only
     #   when *every* tracked muting receiver recovers; refs hash into

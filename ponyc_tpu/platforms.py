@@ -7,15 +7,20 @@ the CPU backend is the config knob *after* importing jax. The
 client is created (first ``jax.devices()`` / trace), which calling this
 helper early guarantees.
 
-One definition, three callers: tests/conftest.py (8-device virtual mesh),
+One definition, four callers: tests/conftest.py (8-device virtual mesh),
 __graft_entry__.dryrun_multichip (driver validation), bench.py (smoke
-runs / TPU-init fallback).
+runs / TPU-init fallback), and every example via ``auto_backend`` —
+first contact must never hang on a wedged accelerator plugin (the
+reference binary runs wherever it was compiled for; a TPU program's
+equivalent courtesy is falling back to CPU loudly).
 """
 
 from __future__ import annotations
 
 import os
 import re
+import subprocess
+import sys
 
 
 def force_cpu(n_devices: int | None = None) -> None:
@@ -33,3 +38,65 @@ def force_cpu(n_devices: int | None = None) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+_PROBE_SRC = "import jax; d = jax.devices(); print('PLAT:' + d[0].platform)"
+
+
+def probe_accelerator(timeout_s: float = 30.0):
+    """Initialise JAX in a THROWAWAY subprocess and report the default
+    platform, or None if init fails/hangs/resolves to CPU.
+
+    A hung backend init (observed: the axon TPU plugin blocking
+    ``jax.devices()`` for 25+ minutes when the tunnel is wedged) must
+    only ever cost the subprocess — probing in-process would wedge this
+    process's backend lock forever. Returns (platform_or_None, error).
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, (f"jax.devices() did not return within {timeout_s:.0f}s "
+                      "(backend init hang)")
+    except OSError as e:                      # no child processes allowed
+        return None, f"probe subprocess failed to launch: {e}"
+    plat = None
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("PLAT:"):
+            plat = line[5:].strip()
+    if r.returncode == 0 and plat and plat != "cpu":
+        return plat, None
+    if r.returncode == 0:
+        return None, f"backend initialised as {plat!r}, not an accelerator"
+    return None, ((r.stderr or r.stdout or "").strip()[-1000:]
+                  or f"probe exited rc={r.returncode}")
+
+
+def auto_backend(probe_timeout_s: float = 20.0, *, quiet: bool = False):
+    """First-contact backend selection for examples and small programs.
+
+    Probes the accelerator with a bounded subprocess; on failure or
+    hang, forces the CPU backend so the program runs NOW instead of
+    blocking inside a wedged plugin init. Override with
+    ``PONY_TPU_PLATFORM=tpu`` (no fallback — init errors surface
+    in-process) or ``PONY_TPU_PLATFORM=cpu`` (skip the probe).
+    Returns the chosen platform name.
+    """
+    want = os.environ.get("PONY_TPU_PLATFORM", "auto").lower()
+    if want == "cpu" or os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # Already pinned to CPU — don't pay a probe subprocess (or warn)
+        # on the common dev/test path.
+        force_cpu()
+        return "cpu"
+    if want in ("tpu", "accel"):
+        return "tpu"          # trust the env: no forcing, fail loudly
+    plat, err = probe_accelerator(probe_timeout_s)
+    if plat is None:
+        if not quiet:
+            print(f"ponyc_tpu: accelerator unavailable ({err}); "
+                  "running on CPU. Set PONY_TPU_PLATFORM=tpu to wait "
+                  "for the accelerator instead.", file=sys.stderr)
+        force_cpu()
+        return "cpu"
+    return plat

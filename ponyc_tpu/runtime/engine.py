@@ -268,27 +268,26 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     if opts.pallas_fused and nb >= 1 and not cohort.spawns:
         from ..ops import fused_dispatch as fd
         from ..ops import mailbox_kernel as mk
-    if (opts.pallas_fused and nb >= 1 and not cohort.spawns
-            and (rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0)):
-        # Probe-trace every branch so `effects` is discovered BEFORE the
-        # path decision (the fused kernel cannot host destroy/error/
-        # sync-construction bookkeeping).
-        for br in branches:
-            jax.eval_shape(
-                br,
-                {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
-                 for f in cohort.atype.field_specs},
-                jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
-                jax.ShapeDtypeStruct((rows,), jnp.int32), {})
-        if fd.eligible(cohort, effects, opts):
-            fnames = tuple(cohort.atype.field_specs.keys())
-            fused = (fd.build_fused_dispatch(
-                cohort.behaviours, base_gid=base,
-                field_names=fnames, field_dtypes=field_dtypes,
-                field_specs=cohort.atype.field_specs, batch=batch,
-                cap=cap, msg_words=msg_words, ms=ms, rows=rows,
-                noyield=noyield, interpret=mk.interpret_mode()),
-                fnames)
+        if rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0:
+            # Probe-trace every branch so `effects` is discovered BEFORE
+            # the path decision (the fused kernel cannot host destroy/
+            # error/sync-construction bookkeeping).
+            for br in branches:
+                jax.eval_shape(
+                    br,
+                    {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
+                     for f in cohort.atype.field_specs},
+                    jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
+                    jax.ShapeDtypeStruct((rows,), jnp.int32), {})
+            if fd.eligible(cohort, effects, opts):
+                fnames = tuple(cohort.atype.field_specs.keys())
+                fused = (fd.build_fused_dispatch(
+                    cohort.behaviours, base_gid=base,
+                    field_names=fnames, field_dtypes=field_dtypes,
+                    field_specs=cohort.atype.field_specs, batch=batch,
+                    cap=cap, msg_words=msg_words, ms=ms, rows=rows,
+                    noyield=noyield, interpret=mk.interpret_mode()),
+                    fnames)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
                    runnable_rows, ids, resv):
@@ -651,6 +650,18 @@ def build_step(program: Program, opts: RuntimeOptions):
                 jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
                 (st.dspill_tgt >= 0).astype(jnp.int32), nl),
             lambda _: jnp.zeros((nl,), jnp.int32), operand=None)
+        # Mesh-wide "live congested" bits for the aging veto below: a
+        # muter that still shows congestion evidence AND can run to
+        # drain it must hold its muted senders no matter which shard it
+        # lives on. Gathered OUTSIDE the unmute cond (collectives must
+        # run collectively; jnp.any(st.muted) is shard-local).
+        live_cong = (((occ0 > opts.unmute_occ) | (dspill_pending > 0))
+                     & st.alive & ~st.muted)
+        if p > 1:
+            live_cong_global = lax.all_gather(live_cong, "actors",
+                                              tiled=True)
+        else:
+            live_cong_global = live_cong
         def unmute_pass(_):
             # ≙ ponyint_sched_unmute_senders walking the mutemap
             # receiver-set (scheduler.c:1552-1635): a sender releases only
@@ -700,18 +711,48 @@ def build_step(program: Program, opts: RuntimeOptions):
             # synchronized wave into the still-full receiver could blow
             # the bounded spill. Phasing spreads releases over `limit`
             # ticks, so the per-tick wave is ~n_muted/limit.
-            lim = max(1, opts.mute_age_limit)
-            threshold = lim + jnp.arange(nl, dtype=jnp.int32) % lim
-            aged = st.mute_age >= threshold
-            held_by_pressure = jnp.any(
-                (refs >= 0) & jnp.take(
-                    pressured_global, jnp.maximum(refs, 0), mode="clip"),
-                axis=0)
-            # Overflowed ref sets may have EVICTED a pressured ref, so
-            # aging defers while any pressure exists anywhere — the same
-            # conservative rule as the non-aged ovf path.
-            aged_ok = (aged & ~held_by_pressure
-                       & (~st.mute_ovf | ~jnp.any(pressured_global)))
+            if opts.mute_age_limit > 0:
+                lim = opts.mute_age_limit
+                threshold = lim + jnp.arange(nl, dtype=jnp.int32) % lim
+                aged = st.mute_age >= threshold
+                held_by_pressure = jnp.any(
+                    (refs >= 0) & jnp.take(
+                        pressured_global, jnp.maximum(refs, 0),
+                        mode="clip"),
+                    axis=0)
+                # A tracked muter (on ANY shard — live_cong_global) that
+                # still shows LIVE congestion evidence (occ above the
+                # unmute threshold, or messages parked in its shard's
+                # device spill) and that can still run to drain it
+                # (alive, not itself muted) vetoes aging: releasing a
+                # sender into a receiver that is actively being worked
+                # just grows the bounded spill until overflow — the
+                # reference never releases while the muter is
+                # overloaded/pressured (scheduler.c:1552-1635). Aging
+                # therefore only breaks TRUE mute-cycle deadlocks, where
+                # every congested muter is itself muted or dead and can
+                # never run to recover. A non-empty local route spill
+                # additionally holds any sender with a remote ref: the
+                # backlog bound for that muter is still in flight here,
+                # so its congestion state is not yet observable.
+                held_by_live = jnp.any(
+                    has & jnp.take(live_cong_global,
+                                   jnp.maximum(refs, 0), mode="clip"),
+                    axis=0)
+                if p > 1:
+                    has_remote = jnp.any(has & ~ref_local, axis=0)
+                    held_by_live = held_by_live | (
+                        has_remote & (st.rspill_count[0] > 0))
+                # Overflowed ref sets may have EVICTED a pressured ref, so
+                # aging defers while any pressure exists anywhere — the
+                # same conservative rule as the non-aged ovf path.
+                aged_ok = (aged & ~held_by_pressure & ~held_by_live
+                           & (~st.mute_ovf | ~jnp.any(pressured_global)))
+            else:
+                # mute_age_limit <= 0: aging deadlock-breaker disabled
+                # (reference mute semantics exactly — documented opt-out
+                # in config.py).
+                aged_ok = jnp.zeros((nl,), jnp.bool_)
             release = st.muted & (
                 (all_ok & (~st.mute_ovf | shard_quiet))
                 | aged_ok)

@@ -818,7 +818,7 @@ class Runtime:
             if not busy:
                 terminating = (self._noisy == 0
                                and (not self._bridge_pollers
-                                    or idle_polls >= 2))
+                                    or idle_polls > 2))
                 if terminating:
                     # Cleanup ticks ON THE TERMINATION PATH ONLY: the
                     # unmute pass lags the drain that satisfies it by

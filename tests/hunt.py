@@ -2,7 +2,10 @@
 """Extended differential bug hunt — the long-running version of
 tests/test_differential.py, run as a one-off (not under pytest):
 
-    python tests/hunt.py [n_seeds] [first_seed]
+    python tests/hunt.py [n_seeds] [first_seed] [--fifo]
+
+--fifo runs the order-sensitive per-edge FIFO marathon (test_fifo.py
+scenarios) instead of the commutative-outcome differential.
 
 Random world sizes and traffic per seed, rotating configurations
 (tiny-cap single chip, cosort, fused kernel, 4/8-shard meshes with tiny
@@ -45,9 +48,57 @@ CONFIGS = {
 }
 
 
+FIFO_CONFIGS = {
+    "tiny": dict(mailbox_cap=2, batch=1, max_sends=3, spill_cap=4096,
+                 inject_slots=16),
+    "cosort": dict(mailbox_cap=4, batch=2, max_sends=3, spill_cap=4096,
+                   inject_slots=16, delivery="cosort"),
+    "aged": dict(mailbox_cap=2, batch=1, max_sends=3, spill_cap=4096,
+                 inject_slots=16, mute_age_limit=2),
+    "fused": dict(mailbox_cap=4, batch=2, max_sends=3, spill_cap=4096,
+                  inject_slots=16, pallas_fused=True),
+    "mesh4-bucket": dict(mailbox_cap=2, batch=1, max_sends=3,
+                         spill_cap=8192, inject_slots=32, mesh_shards=4,
+                         route_bucket=8, quiesce_interval=2),
+}
+
+
+def main_fifo(n_seeds, first):
+    """Order-sensitive marathon: random fan-in wiring + stream lengths,
+    per-edge sequence stamps verified on device (test_fifo.run_fifo) —
+    a single FIFO inversion anywhere in delivery/spill/route/aged-unmute
+    fails the seed."""
+    import test_fifo as tf
+    fails = []
+    t0 = time.time()
+    names = list(FIFO_CONFIGS)
+    for n, seed in enumerate(range(first, first + n_seeds)):
+        rng = np.random.default_rng(seed)
+        n_cons = int(rng.integers(3, 12))
+        items = int(rng.integers(20, 90))
+        cfg = names[n % len(names)]
+        try:
+            tf.run_fifo(seed, FIFO_CONFIGS[cfg], n_cons=n_cons,
+                        items=items)
+        except Exception as e:                  # noqa: BLE001
+            fails.append((seed, cfg, repr(e)[:200]))
+        print(f"fifo seed {seed} ({cfg}, n_cons={n_cons}, items={items}): "
+              f"{'FAIL' if fails and fails[-1][0] == seed else 'ok'}",
+              flush=True)
+    print(f"\n{n_seeds - len(fails)}/{n_seeds} fifo ok "
+          f"in {time.time() - t0:.0f}s")
+    for f in fails:
+        print("FAIL:", f)
+    return 1 if fails else 0
+
+
 def main():
-    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
-    first = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    argv = [a for a in sys.argv[1:] if a != "--fifo"]
+    fifo = "--fifo" in sys.argv[1:]
+    n_seeds = int(argv[0]) if len(argv) > 0 else 10
+    first = int(argv[1]) if len(argv) > 1 else 1000
+    if fifo:
+        return main_fifo(n_seeds, first)
     fails = []
     t0 = time.time()
     names = list(CONFIGS)

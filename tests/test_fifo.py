@@ -1,0 +1,216 @@
+"""Order-SENSITIVE differential testing: per-edge FIFO (causal order).
+
+The Pony guarantee under test: messages from sender A to receiver B are
+dispatched in the order A sent them (messageq FIFO,
+reference src/libponyrt/actor/messageq.c:102-160). The commutative
+differential suite (test_differential.py) cannot see an ordering
+violation by design; this file can see a SINGLE one.
+
+Method: every producer stamps each message with a per-edge sequence
+number; every consumer checks ON DEVICE that each in-edge's stamps
+arrive exactly contiguous (seq == last_seen + 1) and counts violations.
+The per-edge oracle sequence is 0,1,2,… by construction, so
+`violations == 0` + `last_seen == n-1` IS the exact oracle comparison —
+any inversion, duplication, or loss anywhere in delivery (plan/cosort),
+the device spill retry, the route-spill retry, or the aged-unmute
+release window trips it.
+
+Configs deliberately aim at the reordering windows SURVEY §7 hard part
+(c) names: tiny caps (device-spill retry), 4-shard mesh with a tiny
+route bucket (route-spill retry), aggressive mute aging (aged-unmute
+release), both delivery formulations, and the fused Pallas kernel.
+"""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+IN_SLOTS = 4          # in-edges tracked per consumer (fixed-width state)
+
+
+@actor
+class Cons:
+    """Consumer with IN_SLOTS tracked in-edges: asserts per-edge stamps
+    arrive contiguous; `bad` counts every FIFO violation."""
+    last0: I32
+    last1: I32
+    last2: I32
+    last3: I32
+    bad: I32
+    got: I32
+
+    BATCH = 1          # slow consumer → overload → mute machinery engages
+
+    @behaviour
+    def consume(self, st, slot: I32, seq: I32):
+        upd = {"bad": st["bad"], "got": st["got"] + 1}
+        for s in range(IN_SLOTS):
+            is_s = slot == s
+            last = st[f"last{s}"]
+            viol = is_s & (seq != last + 1)
+            upd["bad"] = upd["bad"] + np.int32(1) * viol
+            upd[f"last{s}"] = last + (seq - last) * is_s
+        return {**st, **upd}
+
+
+@actor
+class Prod:
+    """Producer streaming to two fixed (consumer, slot) edges, one stamp
+    per tick via a self-send chain (so its own mailbox also carries a
+    FIFO-critical stream: the self-edge n,n-1,… chain)."""
+    c1: Ref["Cons"]
+    c2: Ref["Cons"]
+    slot1: I32
+    slot2: I32
+    seq: I32
+
+    MAX_SENDS = 3
+
+    @behaviour
+    def produce(self, st, n: I32):
+        self.send(st["c1"], Cons.consume, st["slot1"], st["seq"], when=n > 0)
+        self.send(st["c2"], Cons.consume, st["slot2"], st["seq"], when=n > 0)
+        self.send(self.actor_id, Prod.produce, n - 1, when=n > 0)
+        return {**st, "seq": st["seq"] + (n > 0) * np.int32(1)}
+
+
+def _wire(seed, n_cons):
+    """Random bipartite wiring: every consumer gets exactly IN_SLOTS
+    in-edges, every producer exactly two out-edges (a producer may draw
+    two slots of the SAME consumer — two edges into one mailbox)."""
+    rng = np.random.default_rng(seed)
+    pairs = [(c, s) for c in range(n_cons) for s in range(IN_SLOTS)]
+    rng.shuffle(pairs)
+    n_prod = len(pairs) // 2
+    return n_prod, pairs[:n_prod], pairs[n_prod:]
+
+
+def run_fifo(seed, okw, n_cons=6, items=60):
+    n_prod, e1, e2 = _wire(seed, n_cons)
+    opts = RuntimeOptions(msg_words=2, **okw)
+    rt = Runtime(opts)
+    rt.declare(Prod, n_prod).declare(Cons, n_cons)
+    rt.start()
+    cids = rt.spawn_many(Cons, n_cons,
+                         last0=np.full(n_cons, -1, np.int32),
+                         last1=np.full(n_cons, -1, np.int32),
+                         last2=np.full(n_cons, -1, np.int32),
+                         last3=np.full(n_cons, -1, np.int32))
+    pids = rt.spawn_many(Prod, n_prod,
+                         c1=cids[np.asarray([c for c, _ in e1])],
+                         c2=cids[np.asarray([c for c, _ in e2])],
+                         slot1=np.asarray([s for _, s in e1], np.int32),
+                         slot2=np.asarray([s for _, s in e2], np.int32))
+    rt.bulk_send(pids, Prod.produce, np.full(n_prod, items, np.int32))
+    assert rt.run(max_steps=500_000) == 0, "must quiesce"
+    st = rt.cohort_state(Cons)
+    bad = st["bad"][:n_cons]
+    assert not bad.any(), f"FIFO violations: {np.asarray(bad)}"
+    # Completeness: every edge delivered its full stream (the per-slot
+    # last stamp is exactly items-1, matching the oracle sequence).
+    for s in range(IN_SLOTS):
+        last = np.asarray(st[f"last{s}"][:n_cons])
+        assert (last == items - 1).all(), (s, last)
+    got = np.asarray(st["got"][:n_cons])
+    assert (got == IN_SLOTS * items).all(), got
+    # Producer self-chains all ran to exhaustion.
+    pst = rt.cohort_state(Prod)
+    assert (np.asarray(pst["seq"][:n_prod]) == items).all()
+    return rt
+
+
+CONFIGS = [
+    ("tiny-cap-dspill", dict(mailbox_cap=2, batch=1, max_sends=3,
+                             spill_cap=2048, inject_slots=16)),
+    ("cosort", dict(mailbox_cap=4, batch=2, max_sends=3, spill_cap=2048,
+                    inject_slots=16, delivery="cosort")),
+    ("aged-unmute", dict(mailbox_cap=2, batch=1, max_sends=3,
+                         spill_cap=2048, inject_slots=16,
+                         mute_age_limit=2)),
+    ("mesh4-route-spill", dict(mailbox_cap=2, batch=1, max_sends=3,
+                               spill_cap=4096, inject_slots=32,
+                               mesh_shards=4, route_bucket=8,
+                               quiesce_interval=2)),
+    ("fused-kernel", dict(mailbox_cap=4, batch=2, max_sends=3,
+                          spill_cap=2048, inject_slots=16,
+                          pallas_fused=True)),
+]
+
+
+@pytest.mark.parametrize("name,okw", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_per_edge_fifo(name, okw):
+    run_fifo(seed=101, okw=okw)
+
+
+def test_per_edge_fifo_more_seeds_tiny():
+    for seed in (202, 303):
+        run_fifo(seed, CONFIGS[0][1], n_cons=4, items=40)
+
+
+def test_detector_catches_single_inversion():
+    """Sensitivity proof: an artificially inverted pair of stamps on one
+    edge MUST trip the violation counter — the detector is not
+    vacuously green."""
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, msg_words=2,
+                          max_sends=3, spill_cap=64, inject_slots=8)
+    rt = Runtime(opts)
+    rt.declare(Prod, 1).declare(Cons, 1)
+    rt.start()
+    c = rt.spawn(Cons, last0=-1, last1=-1, last2=-1, last3=-1)
+    rt.spawn(Prod)
+    rt.send(c, Cons.consume, 0, 1)     # seq 1 first — inverted
+    rt.send(c, Cons.consume, 0, 0)     # then seq 0
+    rt.run(max_steps=1000)
+    assert rt.state_of(c)["bad"] > 0, \
+        "inverted stamps did not trip the FIFO detector"
+
+
+def test_host_consumer_fifo():
+    """The SAME per-edge streams terminating in a HOST actor: the
+    device→host out-ring drain must preserve per-edge order too (the
+    ASIO-side half of the FIFO claim). The host log records real arrival
+    order; each edge's subsequence must equal 0,1,2,… exactly."""
+    logs = {}
+
+    @actor
+    class HCons:
+        HOST = True
+        got: I32
+
+        @behaviour
+        def consume(self, st, edge: I32, seq: I32):
+            logs.setdefault(int(edge), []).append(int(seq))
+            return {**st, "got": st["got"] + 1}
+
+    n_prod, items = 6, 40
+
+    @actor
+    class HProd:
+        sink: Ref["HCons"]
+        edge: I32
+        seq: I32
+
+        MAX_SENDS = 2
+
+        @behaviour
+        def produce(self, st, n: I32):
+            self.send(st["sink"], HCons.consume, st["edge"], st["seq"],
+                      when=n > 0)
+            self.send(self.actor_id, HProd.produce, n - 1, when=n > 0)
+            return {**st, "seq": st["seq"] + (n > 0) * np.int32(1)}
+
+    opts = RuntimeOptions(mailbox_cap=2, batch=1, msg_words=2, max_sends=2,
+                          spill_cap=2048, inject_slots=16,
+                          host_out_slots=8)   # tiny out-ring → drain churn
+    rt = Runtime(opts)
+    rt.declare(HProd, n_prod).declare(HCons, 1)
+    rt.start()
+    sink = rt.spawn(HCons)
+    pids = rt.spawn_many(HProd, n_prod, sink=np.full(n_prod, sink),
+                         edge=np.arange(n_prod, dtype=np.int32))
+    rt.bulk_send(pids, HProd.produce, np.full(n_prod, items, np.int32))
+    assert rt.run(max_steps=200_000) == 0
+    assert rt.state_of(sink)["got"] == n_prod * items
+    for e in range(n_prod):
+        assert logs.get(e) == list(range(items)), (e, logs.get(e)[:10])

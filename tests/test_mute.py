@@ -116,6 +116,233 @@ def test_fanin_two_receivers_conservation_and_bounded_mutes():
         rt.counter("n_mutes")
 
 
+@actor
+class Flooder:
+    """Flood generator: each received ping fans two pings back at the
+    peer (amplification 2, BATCH 1), keeping both mailboxes full with
+    real traffic."""
+
+    peer: Ref
+    got: I32
+
+    BATCH = 1
+    MAX_SENDS = 2
+
+    @behaviour
+    def ping(self, st, v: I32):
+        self.send(st["peer"], Flooder.ping, v - 1, when=v > 0)
+        self.send(st["peer"], Flooder.ping, v - 1, when=v > 0)
+        return {**st, "got": st["got"] + 1}
+
+
+def _deadlocked_pair(mute_age_limit):
+    """Build the TRUE mutual-mute deadlock: two actors with genuinely
+    full mailboxes, each muted with the other as its (unrecovered,
+    congested) muting ref. No release path exists except aging: each
+    muter's occ stays above unmute_occ because the muter itself is
+    muted and can never run to drain — the mute-cycle deadlock class
+    the round-2 differential hunt found (ROUND3_NOTES.md), which the
+    reference's pre-0.36 backpressure shares.
+
+    Live sends can't assemble this state directly (the reference's
+    !OVERLOADED sender guard, delivery.py `~sender_hot`, keeps two
+    mutually-hot actors from muting each other), so the flood runs
+    until both queues are full of real traffic and the mute tables are
+    then set to the cycle — a unit fixture for the unmute pass.
+    """
+    opts = RuntimeOptions(mailbox_cap=4, batch=1, msg_words=1,
+                          max_sends=2, spill_cap=2048, inject_slots=8,
+                          mute_age_limit=mute_age_limit)
+    rt = Runtime(opts)
+    rt.declare(Flooder, 2)
+    rt.start()
+    a = rt.spawn(Flooder)
+    b = rt.spawn(Flooder, peer=a)
+    rt.set_fields(Flooder, np.asarray([a]), peer=np.asarray([b]))
+    rt.bulk_send(np.asarray([a, b]), Flooder.ping, np.asarray([8, 8]))
+    inj = rt._empty_inject
+    state = rt.state
+    for _ in range(40):   # fill both rings with real messages
+        state, aux = rt._step(state, *inj)
+    occ = np.asarray(state.tail) - np.asarray(state.head)
+    assert (occ > rt.opts.unmute_occ).all(), occ
+    refs = np.full_like(np.asarray(state.mute_refs), -1)
+    refs[b % rt.opts.mute_slots, a] = b       # a muted by b
+    refs[a % rt.opts.mute_slots, b] = a       # b muted by a
+    import dataclasses
+    rt.state = dataclasses.replace(
+        state,
+        muted=jnp.ones_like(state.muted),
+        mute_refs=jnp.asarray(refs),
+        mute_age=jnp.zeros_like(state.mute_age))
+    return rt, a, b
+
+
+def test_aging_breaks_true_mute_cycle():
+    """With aging on, the mutual-mute deadlock drains to completion."""
+    rt, a, b = _deadlocked_pair(mute_age_limit=4)
+    rt.run(max_steps=6000)
+    assert not np.asarray(rt.state.muted).any(), "cycle never broken"
+    occ = np.asarray(rt.state.tail) - np.asarray(rt.state.head)
+    assert (occ == 0).all(), "queues not drained after release"
+
+
+def test_mute_age_limit_zero_disables_aging():
+    """mute_age_limit <= 0 = exact reference semantics: the mutual-mute
+    cycle deadlocks forever (documented divergence opt-out)."""
+    rt, a, b = _deadlocked_pair(mute_age_limit=0)
+    got0 = int(np.asarray(rt.state.type_state["Flooder"]["got"]).sum())
+    rt.run(max_steps=400)
+    assert np.asarray(rt.state.muted).all(), \
+        "deadlocked pair released with aging disabled"
+    got = int(np.asarray(rt.state.type_state["Flooder"]["got"]).sum())
+    assert got == got0, "deadlocked world advanced with aging disabled"
+
+
+def test_aged_release_waits_for_live_congested_muter():
+    """Sustained fan-in against a slow-but-runnable receiver: aging must
+    NOT fire while the muting receiver shows live congestion evidence
+    and can still run (advisor round-3 medium: unconditional aged
+    release grows the bounded spill until overflow). The workload must
+    throttle to completion under muting, exactly as the reference does."""
+    n_pushers, items = 16, 50
+    opts = RuntimeOptions(mailbox_cap=8, batch=2, msg_words=1,
+                          max_sends=3, spill_cap=256, inject_slots=16,
+                          mute_age_limit=2)   # aggressive aging
+    rt = Runtime(opts)
+    rt.declare(Pusher, n_pushers).declare(Slow, 1).declare(Fast, 1)
+    rt.start()
+    slow = rt.spawn(Slow)
+    fast = rt.spawn(Fast)
+    ids = rt.spawn_many(Pusher, n_pushers, slow=slow, fast=fast)
+    rt.bulk_send(ids, Pusher.produce, [items] * n_pushers)
+    inj = rt._empty_inject
+    state = rt.state
+    prev = None
+    max_age_seen = 0
+    for _ in range(3000):
+        muted = np.asarray(state.muted)
+        occ = np.asarray(state.tail) - np.asarray(state.head)
+        refs = np.asarray(state.mute_refs)
+        alive = np.asarray(state.alive)
+        dsp = np.asarray(state.dspill_tgt)
+        dsp_pending = np.zeros(rt.program.total, bool)
+        dsp_pending[dsp[dsp >= 0]] = True
+        if prev is not None:
+            released = prev["muted"] & ~muted
+            for s in np.nonzero(released)[0]:
+                rs = prev["refs"][:, s]
+                rs = rs[rs >= 0]
+                live_congested = [
+                    r for r in rs
+                    if (prev["occ"][r] > opts.unmute_occ
+                        or prev["dsp_pending"][r])
+                    and prev["alive"][r] and not prev["muted"][r]]
+                assert not live_congested, (
+                    f"sender {s} released while muter(s) {live_congested} "
+                    f"were runnable and still congested")
+        max_age_seen = max(max_age_seen,
+                           int(np.asarray(state.mute_age).max()))
+        prev = dict(muted=muted, occ=occ, refs=refs, alive=alive,
+                    dsp_pending=dsp_pending)
+        state, aux = rt._step(state, *inj)
+        assert not bool(aux.spill_overflow), \
+            "aged releases blew the bounded spill"
+        if not bool(aux.device_pending):
+            break
+    rt.state = state
+    assert rt.state_of(slow)["total"] == n_pushers * items
+    assert rt.state_of(fast)["total"] == n_pushers * items
+    # Not vacuous: senders stayed muted well past the aging threshold
+    # (limit=2 staggers thresholds into [2, 4)), i.e. aging was
+    # age-eligible and the live-congestion veto is what held it.
+    assert max_age_seen >= 2 * opts.mute_age_limit, max_age_seen
+
+
+def test_aged_release_waits_cross_shard():
+    """The mesh twin of the live-congestion aging veto: senders mute
+    against a slow-but-runnable receiver on ANOTHER shard, whose
+    congestion they can only see through the all-gathered live_cong
+    bits. With aggressive aging (limit=2), no sender may be released
+    while any of its tracked muters — local or remote — is alive,
+    unmuted, and still congested (occ or pending spill)."""
+    n_pushers, items = 32, 40
+    opts = RuntimeOptions(mailbox_cap=8, batch=2, msg_words=1,
+                          max_sends=3, spill_cap=4096, inject_slots=64,
+                          mute_age_limit=2, mesh_shards=4,
+                          quiesce_interval=1, route_bucket=8)
+    rt = Runtime(opts)
+    rt.declare(Pusher, n_pushers).declare(Slow, 1).declare(Fast, 1)
+    rt.start()
+    slow = rt.spawn(Slow)
+    fast = rt.spawn(Fast)
+    ids = rt.spawn_many(Pusher, n_pushers, slow=slow, fast=fast)
+    rt.bulk_send(ids, Pusher.produce, [items] * n_pushers)
+    p, nl = rt.program.shards, rt.program.n_local
+    prev = None
+    max_age_seen = 0
+    cross_shard_mutes = 0
+    for _ in range(4000):
+        st = rt.state
+        muted = np.asarray(st.muted)
+        occ = np.asarray(st.tail) - np.asarray(st.head)
+        refs = np.asarray(st.mute_refs)          # global ref ids
+        alive = np.asarray(st.alive)
+        ovf = np.asarray(st.mute_ovf)
+        dsp = np.asarray(st.dspill_tgt).reshape(p, -1)
+        pending = np.zeros(rt.program.total, bool)
+        for s in range(p):
+            loc = dsp[s][dsp[s] >= 0]
+            pending[s * nl + loc] = True
+        if prev is not None:
+            released = prev["muted"] & ~muted
+            for g in np.nonzero(released)[0]:
+                if prev["ovf"][g]:
+                    continue
+                rs = prev["refs"][:, g]
+                rs = rs[rs >= 0]
+                local = rs[rs // nl == g // nl]
+                remote = rs[rs // nl != g // nl]
+                live = [r for r in rs
+                        if (prev["occ"][r] > opts.unmute_occ
+                            or prev["pending"][r])
+                        and prev["alive"][r] and not prev["muted"][r]]
+                # A live-congested LOCAL muter blocks every release path
+                # (normal local_ok and the aged veto alike).
+                assert not [r for r in live if r in local], (
+                    f"sender {g} released past live local muter(s)")
+                # With a remote ref and a non-empty local route spill,
+                # neither remote_ok (spill not drained) nor aging (the
+                # has_remote hold) may release. With the spill drained,
+                # remote_ok releases even into a still-congested remote
+                # receiver — the documented divergence (engine.py
+                # remote_ok comment: routing re-mutes if it persists) —
+                # so that case is allowed.
+                if len(remote) and prev["rspill"][g // nl] > 0:
+                    raise AssertionError(
+                        f"sender {g} released while its shard's route "
+                        f"spill held {prev['rspill'][g // nl]} messages "
+                        "(cross-shard aging veto hole)")
+        for g in np.nonzero(muted)[0]:
+            rs = refs[:, g]
+            if any(r >= 0 and r // nl != g // nl for r in rs):
+                cross_shard_mutes += 1
+        max_age_seen = max(max_age_seen, int(np.asarray(st.mute_age).max()))
+        prev = dict(muted=muted, occ=occ, refs=refs, alive=alive,
+                    pending=pending, ovf=ovf,
+                    rspill=np.asarray(st.rspill_count))
+        rt.run(max_steps=1)
+        if (rt.state_of(slow)["total"] == n_pushers * items
+                and rt.state_of(fast)["total"] == n_pushers * items):
+            break
+    assert rt.state_of(slow)["total"] == n_pushers * items
+    assert rt.state_of(fast)["total"] == n_pushers * items
+    assert cross_shard_mutes > 0, "never saw a cross-shard mute ref"
+    assert max_age_seen >= 2 * opts.mute_age_limit, max_age_seen
+    rt.run(max_steps=100)
+    assert not np.asarray(rt.state.muted).any()
+
+
 def test_release_only_after_all_refs_recover():
     """Step manually; any sender released between ticks must have had
     every tracked muting receiver already recovered (or overflow+quiet)."""
