@@ -650,18 +650,24 @@ def build_step(program: Program, opts: RuntimeOptions):
                 jnp.minimum(jnp.maximum(st.dspill_tgt, 0), nl - 1),
                 (st.dspill_tgt >= 0).astype(jnp.int32), nl),
             lambda _: jnp.zeros((nl,), jnp.int32), operand=None)
-        # Mesh-wide "live congested" bits for the aging veto below: a
-        # muter that still shows congestion evidence AND can run to
-        # drain it must hold its muted senders no matter which shard it
-        # lives on. Gathered OUTSIDE the unmute cond (collectives must
-        # run collectively; jnp.any(st.muted) is shard-local).
+        # Mesh-wide muter-status bits for the aging veto below, packed
+        # into one gather (bit 0: live-congested — shows congestion
+        # evidence AND can run to drain it; bit 1: can-recover — alive
+        # and unmuted, i.e. not itself deadlocked). Gathered OUTSIDE the
+        # unmute cond (collectives must run collectively; jnp.any(
+        # st.muted) is shard-local).
+        can_recover = st.alive & ~st.muted
         live_cong = (((occ0 > opts.unmute_occ) | (dspill_pending > 0))
-                     & st.alive & ~st.muted)
+                     & can_recover)
+        muter_bits = (live_cong.astype(jnp.int32)
+                      | (can_recover.astype(jnp.int32) << 1))
         if p > 1:
-            live_cong_global = lax.all_gather(live_cong, "actors",
-                                              tiled=True)
+            muter_bits_global = lax.all_gather(muter_bits, "actors",
+                                               tiled=True)
         else:
-            live_cong_global = live_cong
+            muter_bits_global = muter_bits
+        live_cong_global = (muter_bits_global & 1) > 0
+        can_recover_global = (muter_bits_global & 2) > 0
         def unmute_pass(_):
             # ≙ ponyint_sched_unmute_senders walking the mutemap
             # receiver-set (scheduler.c:1552-1635): a sender releases only
@@ -732,17 +738,26 @@ def build_step(program: Program, opts: RuntimeOptions):
                 # therefore only breaks TRUE mute-cycle deadlocks, where
                 # every congested muter is itself muted or dead and can
                 # never run to recover. A non-empty local route spill
-                # additionally holds any sender with a remote ref: the
-                # backlog bound for that muter is still in flight here,
-                # so its congestion state is not yet observable.
+                # additionally holds any sender with a remote muter that
+                # can still RECOVER (alive, unmuted): the backlog bound
+                # for that muter is still in flight here, so its
+                # congestion state is not yet observable. A remote muter
+                # that is itself muted/dead gives no such hold — its
+                # route-spill backlog can never drain (muted receivers
+                # don't run), and holding on it would re-create the
+                # cross-shard mute-cycle deadlock aging exists to break.
                 held_by_live = jnp.any(
                     has & jnp.take(live_cong_global,
                                    jnp.maximum(refs, 0), mode="clip"),
                     axis=0)
                 if p > 1:
-                    has_remote = jnp.any(has & ~ref_local, axis=0)
+                    remote_recover = jnp.any(
+                        has & ~ref_local
+                        & jnp.take(can_recover_global,
+                                   jnp.maximum(refs, 0), mode="clip"),
+                        axis=0)
                     held_by_live = held_by_live | (
-                        has_remote & (st.rspill_count[0] > 0))
+                        remote_recover & (st.rspill_count[0] > 0))
                 # Overflowed ref sets may have EVICTED a pressured ref, so
                 # aging defers while any pressure exists anywhere — the
                 # same conservative rule as the non-aged ovf path.

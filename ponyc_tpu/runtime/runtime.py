@@ -942,10 +942,23 @@ class Runtime:
             cnt = int(np.asarray(getattr(st, name + "_count")).sum())
             assert cnt <= tgts.shape[0], f"{name} count exceeds capacity"
 
+    @staticmethod
+    def _fetch(arr) -> np.ndarray:
+        """Host-read a runtime array. On a multi-PROCESS mesh the shards
+        live on other hosts, so fetching is a collective
+        (process_allgather) — every rank must read at the same program
+        point, which the SPMD host-driver contract already requires
+        (tests/_dist_worker.py)."""
+        if (hasattr(arr, "is_fully_addressable")
+                and not arr.is_fully_addressable):
+            from jax.experimental import multihost_utils
+            arr = multihost_utils.process_allgather(arr, tiled=True)
+        return np.asarray(arr)
+
     def counter(self, name: str) -> int:
         """Sum a per-shard runtime counter (n_processed, n_delivered,
         n_rejected, n_badmsg, n_deadletter, n_mutes) over the mesh."""
-        return int(np.asarray(getattr(self.state, name)).sum())
+        return int(self._fetch(getattr(self.state, name)).sum())
 
     def state_of(self, actor_id: int) -> Dict[str, Any]:
         cohort = self.program.cohort_of(actor_id)
@@ -953,7 +966,13 @@ class Runtime:
             return dict(self._host_state.get(actor_id, {}))
         col = int(cohort.gid_to_col(actor_id))
         ts = self.state.type_state[cohort.atype.__name__]
-        return {k: np.asarray(v[col]).item() for k, v in ts.items()}
+        # Addressable arrays: slice on device (one element crosses the
+        # wire, not the column); only a multi-process mesh pays the
+        # collective whole-array fetch.
+        return {k: (np.asarray(v[col]).item()
+                    if getattr(v, "is_fully_addressable", True)
+                    else self._fetch(v)[col].item())
+                for k, v in ts.items()}
 
     def cohort_state(self, atype: ActorTypeMeta) -> Dict[str, np.ndarray]:
         """State columns in *slot order* (spawn order), whatever the shard
@@ -961,7 +980,7 @@ class Runtime:
         cohort = self.program.by_type[atype]
         cols = np.asarray(
             cohort.slot_to_col(np.arange(cohort.capacity)), np.int64)
-        return {k: np.asarray(v)[cols]
+        return {k: self._fetch(v)[cols]
                 for k, v in self.state.type_state[atype.__name__].items()}
 
     @property

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Campaign supervisor: keep exactly ONE _profile_all.py alive until the
+# results file says ALL_DONE or the deadline passes. Never kills a
+# claim-waiting client (that re-wedges the tunnel) — only relaunches
+# after the previous attempt exits on its own.
+DEADLINE=${CAMPAIGN_DEADLINE:?set CAMPAIGN_DEADLINE (epoch s)}
+LOG=/tmp/p9_campaign.log
+while true; do
+  now=$(date +%s)
+  [ "$now" -ge "$DEADLINE" ] && { echo "[$(date -u +%H:%M:%S)] deadline, supervisor exit" >> "$LOG"; break; }
+  grep -q "ALL_DONE" /tmp/p9_results.txt 2>/dev/null && { echo "[$(date -u +%H:%M:%S)] ALL_DONE, supervisor exit" >> "$LOG"; break; }
+  if ! pgrep -f "_profile_all.py" > /dev/null; then
+    echo "[$(date -u +%H:%M:%S)] launching _profile_all.py" >> "$LOG"
+    python -u /root/repo/profiling/_profile_all.py >> /tmp/p9_all.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] attempt exited rc=$?" >> "$LOG"
+    sleep 60
+  else
+    sleep 60
+  fi
+done

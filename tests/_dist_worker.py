@@ -1,0 +1,71 @@
+"""Worker for test_distributed's engine-across-processes tests.
+
+Run identically on every rank (argv: coordinator rank nprocs); each rank
+owns 4 virtual CPU devices, the global mesh has nprocs*4 shards, and the
+ACTOR ENGINE itself (not just a bare psum) runs over the process
+boundary: ubench traffic and a cross-shard ring, with the same
+conservation counters dryrun_multichip checks (__graft_entry__.py).
+
+Host-side determinism contract: every rank performs the SAME host calls
+(spawns, seeds, run loop) so the replicated inject buffers and jit
+dispatch counts stay in lockstep — the multi-controller SPMD programming
+model (one controller per host, identical traces), which is how every
+multi-host JAX program is driven.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+coord, rank, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import ponyc_tpu.parallel.distributed as dist          # noqa: E402
+
+dist.initialize(coordinator=coord, num_processes=nprocs, process_id=rank)
+
+import jax                                             # noqa: E402
+import numpy as np                                     # noqa: E402
+
+assert jax.process_count() == nprocs, jax.process_count()
+assert len(jax.devices()) == 4 * nprocs
+
+from ponyc_tpu import RuntimeOptions                   # noqa: E402
+from ponyc_tpu.models import ring, ubench              # noqa: E402
+
+shards = 4 * nprocs
+
+# --- 1. ubench: sustained all-to-all traffic over the process boundary.
+n, pings, hops = 64, 2, 40
+opts = RuntimeOptions(mailbox_cap=4, batch=pings, max_sends=1,
+                      msg_words=1, spill_cap=512, inject_slots=8,
+                      mesh_shards=shards, quiesce_interval=2)
+rt, ids = ubench.build(n, opts, pings=pings)
+ubench.seed_all(rt, ids, hops=hops, pings=pings)
+rc = rt.run(max_steps=20_000)
+assert rc == 0, rc
+# Conservation (≙ dryrun_multichip): every seeded chain ran to
+# exhaustion — hops+1 dispatches per seed, none lost, none duplicated.
+done = rt.counter("n_processed")
+assert done == n * pings * (hops + 1), (done, n * pings * (hops + 1))
+print(f"RANK{rank}_UBENCH_OK processed={done}", flush=True)
+
+# --- 2. ring whose every hop crosses a shard (and every 4th hop crosses
+# the PROCESS boundary): one node per shard.
+ring_hops = 64
+opts2 = RuntimeOptions(mailbox_cap=4, batch=1, max_sends=1, msg_words=1,
+                       spill_cap=64, inject_slots=8, mesh_shards=shards,
+                       quiesce_interval=2)
+rt2, ids2 = ring.build(shards, opts2)
+rt2.send(int(ids2[0]), ring.RingNode.token, ring_hops)
+rc2 = rt2.run(max_steps=20_000)
+assert rc2 == 0, rc2
+done2 = rt2.counter("n_processed")
+assert done2 == ring_hops, (done2, ring_hops)
+print(f"RANK{rank}_RING_OK hops={done2}", flush=True)
+print(f"RANK{rank}_ALL_OK", flush=True)

@@ -213,4 +213,5 @@ def test_host_consumer_fifo():
     assert rt.run(max_steps=200_000) == 0
     assert rt.state_of(sink)["got"] == n_prod * items
     for e in range(n_prod):
-        assert logs.get(e) == list(range(items)), (e, logs.get(e)[:10])
+        assert logs.get(e) == list(range(items)), (e, (logs.get(e)
+                                                       or [])[:10])

@@ -199,6 +199,69 @@ def test_mute_age_limit_zero_disables_aging():
     assert got == got0, "deadlocked world advanced with aging disabled"
 
 
+def _flood_pair_mesh(mute_age_limit):
+    """Cross-shard twin of _deadlocked_pair, formed NATURALLY: the two
+    Flooders live on different shards (1 row each) and the tiny route
+    bucket's rejections route-mute BOTH of them against each other
+    within a few ticks (full mailboxes, cross mute refs, route spill
+    oscillating) — the cross-shard mutual-mute cycle, no state surgery
+    required."""
+    opts = RuntimeOptions(mailbox_cap=4, batch=1, msg_words=1,
+                          max_sends=2, spill_cap=2048, inject_slots=8,
+                          mute_age_limit=mute_age_limit, mesh_shards=2,
+                          route_bucket=1, quiesce_interval=1)
+    rt = Runtime(opts)
+    rt.declare(Flooder, 2)
+    rt.start()
+    a = rt.spawn(Flooder)
+    b = rt.spawn(Flooder, peer=a)
+    rt.set_fields(Flooder, np.asarray([a]), peer=np.asarray([b]))
+    rt.bulk_send(np.asarray([a, b]), Flooder.ping, np.asarray([8, 8]))
+    inj = rt._empty_inject
+    state = rt.state
+    for _ in range(10):
+        state, aux = rt._step(state, *inj)
+    muted = np.asarray(state.muted)
+    refs = np.asarray(state.mute_refs)
+    assert muted.all(), f"pair not mutually route-muted: {muted}"
+    assert b in refs[:, a] and a in refs[:, b], refs
+    occ = np.asarray(state.tail) - np.asarray(state.head)
+    assert (occ > rt.opts.unmute_occ).all(), occ
+    rt.state = state
+    return rt, a, b
+
+
+def test_aging_breaks_cross_shard_mute_cycle():
+    """A mutual-mute cycle SPANNING SHARDS (route-muted, undeliverable
+    route spill) still drains under aging: a remote muter that can never
+    recover gives no in-flight hold."""
+    rt, a, b = _flood_pair_mesh(mute_age_limit=4)
+    rt.run(max_steps=8000)
+    assert not np.asarray(rt.state.muted).any(), \
+        "cross-shard cycle never broken (rspill hold deadlock)"
+    occ = np.asarray(rt.state.tail) - np.asarray(rt.state.head)
+    assert (occ == 0).all(), "queues not drained after release"
+    assert int(np.asarray(rt.state.rspill_count).sum()) == 0
+    # All flood work ran to exhaustion: 2 seeds × (2^9 - 1) dispatches.
+    got = int(np.asarray(rt.state.type_state["Flooder"]["got"]).sum())
+    assert got == 2 * (2 ** 9 - 1), got
+
+
+def test_cross_shard_cycle_self_heals_without_aging():
+    """Unlike the single-shard cycle (which freezes,
+    test_mute_age_limit_zero_disables_aging), the CROSS-shard cycle
+    self-heals even with aging disabled: the remote-ref release path
+    (engine.py remote_ok — release once the local route spill drains)
+    periodically frees each side, so the pair grinds to completion.
+    Pinning this down documents that aging is only load-bearing for
+    same-shard cycles."""
+    rt, a, b = _flood_pair_mesh(mute_age_limit=0)
+    rt.run(max_steps=20_000)
+    got = int(np.asarray(rt.state.type_state["Flooder"]["got"]).sum())
+    assert got == 2 * (2 ** 9 - 1), got
+    assert not np.asarray(rt.state.muted).any()
+
+
 def test_aged_release_waits_for_live_congested_muter():
     """Sustained fan-in against a slow-but-runnable receiver: aging must
     NOT fire while the muting receiver shows live congestion evidence
