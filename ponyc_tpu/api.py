@@ -41,8 +41,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import (Bool, F32, I8, I16, I32, Ref, U8, U16,  # noqa
-                       U32, VecF32, VecI32)  # re-exported
+from .ops.pack import (Bool, F32, I8, I16, I32, Iso, Ref, Tag,  # noqa
+                       U8, U16, U32, Val, VecF32, VecI32)  # re-exported
 
 
 class BehaviourDef:
@@ -175,7 +175,7 @@ class Context:
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
                  "spawn_claims", "destroy_called", "error_flag",
                  "error_code", "error_loc", "error_called", "ref_types",
-                 "_spawn_meta", "sync_inits", "_effected")
+                 "_spawn_meta", "sync_inits", "_effected", "cap_moves")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None,
                  spawn_meta=None):
@@ -201,6 +201,8 @@ class Context:
         # Trace-time typed-ref provenance; the engine tags the typed
         # state fields and typed args into it before dispatch.
         self.ref_types = pack.RefTypes()
+        # Trace-time iso-move discipline (≙ type/alias.c consume rules).
+        self.cap_moves = pack.CapMoves()
         # {target type name: field_specs} for sync construction.
         self._spawn_meta = spawn_meta or {}
         # {target type name: {site index: (state dict, ok mask)}}.
@@ -235,6 +237,22 @@ class Context:
                 raise TypeError(
                     f"sendability: {owner}.{behaviour_def.name} expects "
                     f"Ref[{want}] but was passed a Ref[{got}]")
+        # Iso move discipline (≙ cap.c/alias.c/safeto.c consume rules):
+        # a moved handle may never be used again this dispatch, and an
+        # Iso-parameter send IS a move.
+        where = f"{owner}.{behaviour_def.name} send"
+        for spec, a in zip(behaviour_def.arg_specs, args):
+            if pack.concrete_null_handle(a):
+                continue                  # 0/-1 sentinel: no payload
+            prev = self.cap_moves.was_moved(a)
+            if prev is not None:
+                raise TypeError(
+                    f"capability: use-after-move — payload already moved "
+                    f"by {prev} is passed to {where}")
+        for spec, a in zip(behaviour_def.arg_specs, args):
+            if (pack.cap_mode(spec) == "iso"
+                    and not pack.concrete_null_handle(a)):
+                self.cap_moves.move(a, where)
         payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
         # Planar-aware: payload is [W] (all-constant args) or [W, R]
         # (lane vectors); the gid row matches its trailing shape.

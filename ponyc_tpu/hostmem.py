@@ -7,10 +7,20 @@ the reference count with it. Device mailboxes here are fixed int32 words,
 so host-side objects (socket buffers, strings, arbitrary Python values)
 live in this handle table and messages carry the handle.
 
-Ownership is *move* semantics — `unbox` consumes the handle — which is
-exactly Pony's `iso` send (the common case for network buffers: the
-sender provably loses access, so no GC protocol is needed at all). Use
-`peek` for read-only access without consuming, `drop` to discard.
+Handles carry a REFERENCE CAPABILITY (≙ src/libponyc/type/cap.c:1,
+safeto.c:1 — the qualifiers that make a payload sendable):
+
+- ``iso`` (default, ``box``): moved-unique. `unbox` consumes the handle
+  (≙ Pony's `consume` on an iso send — the sender provably loses
+  access, so no GC protocol is needed at all). Sending it through an
+  ``Iso``-annotated parameter marks it in-flight: peek/unbox before the
+  receiver takes delivery is use-after-send, and a second send is an
+  aliased move — both raise.
+- ``val`` (``box_val``): shared-immutable. Anyone may `peek`; `unbox`
+  (taking ownership) is rejected; aliasing is free. Collected by the
+  tracing GC when unreachable.
+- ``tag`` (``box_tag``): opaque address. Identity/forwarding only —
+  both `peek` and `unbox` are rejected.
 
 Accounting mirrors the reference's USE_MEMTRACK counters
 (scheduler.h:52-66): boxed/unboxed/live and peak-live are queryable.
@@ -19,11 +29,17 @@ Accounting mirrors the reference's USE_MEMTRACK counters
 from __future__ import annotations
 
 import sys
-from typing import Any, Dict
+from typing import Any, Dict, Set
+
+
+class CapabilityError(TypeError):
+    """A handle was used against its capability mode (≙ the compile
+    errors cap.c/safeto.c raise; dynamic here because host code is
+    Python)."""
 
 
 class HostHeap:
-    """Handle table with move-on-unbox semantics (≙ iso message payloads).
+    """Handle table with per-handle capability modes (iso/val/tag).
 
     Handles are positive int32s; 0/-1 never issued (they collide with the
     framework's "empty word" / "no ref" conventions)."""
@@ -31,6 +47,8 @@ class HostHeap:
     def __init__(self):
         self._objs: Dict[int, Any] = {}
         self._sizes: Dict[int, int] = {}
+        self._modes: Dict[int, str] = {}
+        self._in_flight: Set[int] = set()
         self._next = 1
         self.boxed = 0
         self.unboxed = 0
@@ -53,7 +71,9 @@ class HostHeap:
         except TypeError:
             return 64
 
-    def box(self, obj: Any) -> int:
+    def box(self, obj: Any, mode: str = "iso") -> int:
+        if mode not in ("iso", "val", "tag"):
+            raise ValueError(f"unknown capability mode {mode!r}")
         h = self._next
         self._next += 1
         if self._next >= 2**31:         # wrap, skipping live handles
@@ -61,6 +81,7 @@ class HostHeap:
         while self._next in self._objs:
             self._next += 1
         self._objs[h] = obj
+        self._modes[h] = mode
         sz = self._approx_size(obj)
         self._sizes[h] = sz
         self.bytes_live += sz
@@ -69,21 +90,83 @@ class HostHeap:
         self.peak_live = max(self.peak_live, len(self._objs))
         return h
 
+    def box_val(self, obj: Any) -> int:
+        """Box as shared-immutable (≙ val)."""
+        return self.box(obj, mode="val")
+
+    def box_tag(self, obj: Any) -> int:
+        """Box as opaque address (≙ tag)."""
+        return self.box(obj, mode="tag")
+
+    def mode(self, handle: int) -> str:
+        return self._modes[int(handle)]
+
     def unbox(self, handle: int) -> Any:
         """Take ownership (the handle dies). KeyError on double-take —
-        the dynamic cousin of Pony rejecting use-after-send of an iso."""
-        obj = self._objs.pop(int(handle))
-        self.bytes_live -= self._sizes.pop(int(handle), 0)
+        the dynamic cousin of Pony rejecting use-after-send of an iso.
+        Only iso handles can be unboxed: val is shared-immutable (peek),
+        tag is opaque."""
+        h = int(handle)
+        m = self._modes.get(h)
+        if m == "val":
+            raise CapabilityError(
+                f"capability: handle {h} is val (shared-immutable) — "
+                "peek it; ownership never moves")
+        if m == "tag":
+            raise CapabilityError(
+                f"capability: handle {h} is tag (opaque address) — "
+                "it cannot be read or unboxed")
+        if h in self._in_flight:
+            raise CapabilityError(
+                f"capability: use-after-send — iso handle {h} is in "
+                "flight to its receiver")
+        obj = self._objs.pop(h)
+        self._modes.pop(h, None)
+        self.bytes_live -= self._sizes.pop(h, 0)
         self.unboxed += 1
         return obj
 
     def peek(self, handle: int) -> Any:
-        return self._objs[int(handle)]
+        h = int(handle)
+        m = self._modes.get(h)
+        if m == "tag" and h in self._objs:
+            raise CapabilityError(
+                f"capability: handle {h} is tag (opaque address) — "
+                "identity only, no reads")
+        if h in self._in_flight:
+            raise CapabilityError(
+                f"capability: use-after-send — iso handle {h} is in "
+                "flight to its receiver")
+        return self._objs[h]
+
+    def send_iso(self, handle: int) -> None:
+        """Mark an iso handle in flight (called by the runtime when a
+        handle rides an ``Iso``-annotated message parameter). A second
+        send of an in-flight handle is an aliased move."""
+        h = int(handle)
+        if h not in self._objs:
+            raise KeyError(
+                f"capability: iso handle {h} does not exist (already "
+                "moved or never boxed)")
+        m = self._modes.get(h)
+        if m != "iso":
+            return                       # val/tag ride freely
+        if h in self._in_flight:
+            raise CapabilityError(
+                f"capability: aliased move — iso handle {h} is already "
+                "in flight; an iso is moved-unique (box_val to share)")
+        self._in_flight.add(h)
+
+    def receive(self, handle: int) -> None:
+        """Delivery completed: the receiver may now peek/unbox."""
+        self._in_flight.discard(int(handle))
 
     def drop(self, handle: int) -> None:
-        if self._objs.pop(int(handle), HostHeap._MISSING) \
-                is not HostHeap._MISSING:
-            self.bytes_live -= self._sizes.pop(int(handle), 0)
+        h = int(handle)
+        if self._objs.pop(h, HostHeap._MISSING) is not HostHeap._MISSING:
+            self.bytes_live -= self._sizes.pop(h, 0)
+            self._modes.pop(h, None)
+            self._in_flight.discard(h)
             self.unboxed += 1
 
     @property

@@ -112,6 +112,90 @@ class Ref:
         return _RefTo(item)
 
 
+class _CapSpec:
+    """Host-payload capability annotation: Iso / Val / Tag.
+
+    ≙ the reference's reference-capability qualifiers on sendable
+    payloads (src/libponyc/type/cap.c:1, safeto.c:1, alias.c:1):
+
+    - ``Iso`` — moved-unique: the message MOVES the payload; the sender
+      provably loses access. Trace-time discipline (api.Context.send +
+      engine.eval_behaviour) rejects aliased moves (same handle sent
+      twice in one dispatch), use-after-move, and retained-after-move
+      (returning a moved handle in state). Dynamically, HostHeap
+      handles are move-only (unbox consumes) and in-flight handles
+      reject peek/unbox (use-after-send).
+    - ``Val`` — shared-immutable: anyone may read (peek), nobody may
+      take ownership (unbox rejects); aliasing freely allowed.
+    - ``Tag`` — opaque address: identity/forwarding only; peek AND
+      unbox reject.
+
+    The wire word is a HostHeap handle (i32); the mode governs the
+    trace-time move discipline and the dynamic handle rules."""
+
+    __slots__ = ("mode",)
+
+    _NAMES = {"iso": "Iso", "val": "Val", "tag": "Tag"}
+
+    def __init__(self, mode: str):
+        self.mode = mode
+
+    @property
+    def __name__(self) -> str:
+        return self._NAMES[self.mode]
+
+    def __repr__(self):
+        return self.__name__
+
+
+Iso = _CapSpec("iso")
+Val = _CapSpec("val")
+Tag = _CapSpec("tag")
+
+
+def cap_mode(ann):
+    """'iso' / 'val' / 'tag' for capability specs, else None."""
+    return ann.mode if isinstance(ann, _CapSpec) else None
+
+
+def concrete_null_handle(a) -> bool:
+    """True when `a` is a CONCRETE non-positive value — the blessed
+    'no handle' sentinels (0/-1, hostmem.py). These are exempt from the
+    iso-move discipline: CPython interns small ints, so two -1 literals
+    share id() and would otherwise trip a spurious aliased-move."""
+    try:
+        return int(a) <= 0
+    except Exception:                     # noqa: BLE001 — traced/vector
+        return False
+
+
+class CapMoves:
+    """Trace-time iso-move discipline (≙ the consume/alias analysis of
+    type/alias.c + safeto.c, re-expressed at the trace boundary).
+
+    Tracks moved iso payloads by tracer identity, like pack.RefTypes:
+    directly-forwarded values are checked; derived values (jnp.where,
+    arithmetic) are untyped again — gradual, never breaks array code."""
+
+    __slots__ = ("_moved",)
+
+    def __init__(self):
+        self._moved = {}          # id(obj) → (obj, where-description)
+
+    def move(self, obj, where: str):
+        ent = self._moved.get(id(obj))
+        if ent is not None:
+            raise TypeError(
+                f"capability: iso payload moved twice (aliased move) — "
+                f"first by {ent[1]}, again by {where}; an iso is "
+                "moved-unique (send it once, or box it Val for sharing)")
+        self._moved[id(obj)] = (obj, where)
+
+    def was_moved(self, obj):
+        ent = self._moved.get(id(obj))
+        return ent[1] if ent is not None else None
+
+
 class _VecSpec:
     """A fixed-width vector argument: VecF32[k] / VecI32[k].
 
@@ -199,10 +283,12 @@ _MARKERS = (I32, F32, Bool, Ref, U32, I16, U16, I8, U8)
 
 
 def normalize_annotation(ann):
-    """Map a user annotation to a marker class (or typed-ref / vector
-    instance)."""
-    if isinstance(ann, (_RefTo, _VecSpec)):
+    """Map a user annotation to a marker class (or typed-ref / vector /
+    capability instance)."""
+    if isinstance(ann, (_RefTo, _VecSpec, _CapSpec)):
         return ann
+    if isinstance(ann, str) and ann in ("Iso", "Val", "Tag"):
+        return {"Iso": Iso, "Val": Val, "Tag": Tag}[ann]
     if ann in _MARKERS:
         return ann
     if isinstance(ann, str) and ann.endswith("]"):

@@ -141,6 +141,18 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
             raise TypeError(
                 f"sendability: behaviour {bdef} stores a Ref[{got}] "
                 f"into field {k!r} declared Ref[{want}]")
+        # Iso payloads are moved-unique (≙ cap.c/safeto.c): a handle the
+        # behaviour just moved (sent as an Iso parameter) may not ALSO
+        # be retained in state — including leaving an Iso field
+        # untouched after moving it (overwrite with -1 to consume).
+        moved = (None if pack.concrete_null_handle(v)
+                 else ctx.cap_moves.was_moved(v))
+        if moved is not None:
+            raise TypeError(
+                f"capability: behaviour {bdef} retains a moved iso "
+                f"payload in field {k!r} (moved by {moved}); an iso is "
+                "moved-unique — clear the field (e.g. -1) or use Val "
+                "for shared-immutable payloads")
     st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
            for k, v in st2.items()}
     if len(ctx.sends) > max_sends:

@@ -515,6 +515,16 @@ class Runtime:
         words[0] = behaviour_def.global_id
         words[1:] = _host_pack_args(behaviour_def.arg_specs, args,
                                     self.opts.msg_words)
+        # Iso payload discipline at the host boundary (≙ the gc.c send
+        # handler moving ownership with the message): mark the handle in
+        # flight — peeking it now is use-after-send, re-sending it is an
+        # aliased move (hostmem.HostHeap). AFTER packing validated, so a
+        # failed send can never poison the handle.
+        heap = getattr(self, "_heap", None)
+        if heap is not None:
+            for spec, a in zip(behaviour_def.arg_specs, args):
+                if pack.cap_mode(spec) == "iso" and int(a) > 0:
+                    heap.send_iso(int(a))
         self._inject_q.append((int(target), words))
 
     def bulk_send(self, targets, behaviour_def: BehaviourDef, *arg_cols):
@@ -718,6 +728,13 @@ class Runtime:
                 ctx = HostContext(self, aid)
                 st = self._host_state.get(aid, {})
                 args = _host_unpack_args(bdef.arg_specs, msg[1:])
+                heap = getattr(self, "_heap", None)
+                if heap is not None:
+                    # Delivery completes the iso move: the receiver may
+                    # peek/unbox now (≙ the gc.c recv handler).
+                    for spec, a in zip(bdef.arg_specs, args):
+                        if pack.cap_mode(spec) == "iso" and int(a) > 0:
+                            heap.receive(int(a))
                 try:
                     st2 = bdef.fn(ctx, st, *args)
                 except PonyError as e:
@@ -747,7 +764,9 @@ class Runtime:
                                                None) is None:
             from .. import analysis as _analysis_mod
             _analysis_mod.attach(self)
-        self._exit_requested = False
+        # A request_exit() fired BEFORE run() (signal handler, input
+        # callback between runs) must be honoured, not discarded — the
+        # flag is consumed at the break below, never cleared on entry.
         max_steps = max_steps or self.opts.max_steps
         qi = max(1, self.opts.quiesce_interval)
         idle_polls = 0
@@ -812,6 +831,7 @@ class Runtime:
                 self._last_gc_step = self.steps_run
                 self.gc()
             if self._exit_requested:
+                self._exit_requested = False    # consume the request
                 break
             busy = (bool(a.device_pending) or bool(a.host_pending)
                     or bool(self._inject_q))
@@ -854,6 +874,14 @@ class Runtime:
             if max_steps is not None and steps_this_run >= max_steps:
                 break
         return self._exit_code
+
+    def request_exit(self, code: int = 0) -> None:
+        """Ask the run loop to stop at the next host boundary (≙
+        pony_exitcode + the quiescent stop, start.c:345 — but callable
+        from host-side code outside any behaviour, e.g. an input
+        handler or signal callback)."""
+        self._exit_code = int(code)
+        self._exit_requested = True
 
     def stop(self) -> int:
         """Tear down auxiliaries (≙ pony_stop, start.c:332-351): emit the

@@ -1,0 +1,334 @@
+"""Reference-capability modes on payload handles: Iso / Val / Tag.
+
+≙ src/libponyc/type/cap.c:1, safeto.c:1, alias.c:1 — the qualifiers
+that make a payload sendable, re-expressed at this framework's two
+enforcement points: the TRACE (device behaviours — aliased move,
+use-after-move, retained-after-move all fail the build) and the host
+heap (dynamic move/read rules, use-after-send in-flight tracking).
+
+The round-3 verdict's acceptance test: programs today's Ref-lite
+accepts that the new checker rejects — see
+test_ref_lite_passed_this_yesterday below.
+"""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (I32, Iso, Ref, Runtime, RuntimeOptions, Tag, Val,
+                       actor, behaviour)
+from ponyc_tpu.hostmem import CapabilityError, HostHeap
+
+OPTS = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=2, msg_words=2,
+                      inject_slots=8)
+
+
+@actor
+class Holder:
+    payload: Iso
+    got: I32
+
+    @behaviour
+    def take(self, st, h: Iso):
+        return {**st, "payload": h, "got": st["got"] + 1}
+
+
+@actor
+class Reader:
+    seen: I32
+
+    @behaviour
+    def look(self, st, h: Val):
+        return {**st, "seen": st["seen"] + 1}
+
+
+# ---------------- trace-time (device) discipline ----------------
+
+def test_ref_lite_passed_this_yesterday():
+    """Forwarding one iso payload to TWO receivers — an aliased move.
+    With I32 annotations (Ref-lite) this traced clean; declaring the
+    parameter Iso makes the same program fail the BUILD."""
+
+    @actor
+    class BadFanout:
+        a: Ref["Holder"]
+        b: Ref["Holder"]
+        MAX_SENDS = 2
+
+        @behaviour
+        def fan(self, st, h: Iso):
+            self.send(st["a"], Holder.take, h)
+            self.send(st["b"], Holder.take, h)     # second move of h!
+            return st
+
+    rt = Runtime(OPTS)
+    rt.declare(BadFanout, 1).declare(Holder, 2).start()
+    f = rt.spawn(BadFanout)
+    rt.send(f, BadFanout.fan, 7)
+    with pytest.raises(TypeError, match="use-after-move|aliased move"):
+        rt.run(max_steps=4)
+
+
+def test_retained_after_move_rejected():
+    @actor
+    class BadKeep:
+        out: Ref["Holder"]
+        stash: Iso
+        MAX_SENDS = 1
+
+        @behaviour
+        def keep(self, st, h: Iso):
+            self.send(st["out"], Holder.take, h)
+            return {**st, "stash": h}              # retain after move!
+
+    rt = Runtime(OPTS)
+    rt.declare(BadKeep, 1).declare(Holder, 1).start()
+    k = rt.spawn(BadKeep)
+    rt.send(k, BadKeep.keep, 7)
+    with pytest.raises(TypeError, match="retains a moved iso"):
+        rt.run(max_steps=4)
+
+
+def test_iso_field_left_in_state_after_move_rejected():
+    """Moving an Iso FIELD and leaving it untouched in state is the
+    sneaky retain (the field still holds the handle)."""
+
+    @actor
+    class BadField:
+        out: Ref["Holder"]
+        payload: Iso
+        MAX_SENDS = 1
+
+        @behaviour
+        def flush(self, st, _: I32):
+            self.send(st["out"], Holder.take, st["payload"])
+            return st                              # payload still there!
+
+    rt = Runtime(OPTS)
+    rt.declare(BadField, 1).declare(Holder, 1).start()
+    b = rt.spawn(BadField)
+    rt.send(b, BadField.flush, 0)
+    with pytest.raises(TypeError, match="retains a moved iso"):
+        rt.run(max_steps=4)
+
+
+def test_use_after_move_as_other_arg_rejected():
+    @actor
+    class BadReuse:
+        out: Ref["Holder"]
+        log: Ref["Reader"]
+        MAX_SENDS = 2
+
+        @behaviour
+        def go(self, st, h: Iso):
+            self.send(st["out"], Holder.take, h)
+            self.send(st["log"], Reader.look, h)   # use after move
+            return st
+
+    rt = Runtime(OPTS)
+    rt.declare(BadReuse, 1).declare(Holder, 1).declare(Reader, 1).start()
+    b = rt.spawn(BadReuse)
+    rt.send(b, BadReuse.go, 7)
+    with pytest.raises(TypeError, match="use-after-move"):
+        rt.run(max_steps=4)
+
+
+def test_move_once_and_clear_is_legal():
+    """The CORRECT iso protocol: move once, clear the field. Runs."""
+
+    @actor
+    class GoodMove:
+        out: Ref["Holder"]
+        payload: Iso
+        MAX_SENDS = 1
+
+        @behaviour
+        def flush(self, st, _: I32):
+            self.send(st["out"], Holder.take, st["payload"])
+            return {**st, "payload": np.int32(-1)}   # consumed
+
+    rt = Runtime(OPTS)
+    rt.declare(GoodMove, 1).declare(Holder, 1).start()
+    h = rt.spawn(Holder)
+    g = rt.spawn(GoodMove, out=int(h), payload=42)
+    rt.send(g, GoodMove.flush, 0)
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(int(h))["got"] == 1
+    assert rt.state_of(int(h))["payload"] == 42
+    assert rt.state_of(int(g))["payload"] == -1
+
+
+def test_val_aliases_freely():
+    """Shared-immutable payloads fan out without restriction."""
+
+    @actor
+    class GoodFan:
+        a: Ref["Reader"]
+        b: Ref["Reader"]
+        MAX_SENDS = 2
+
+        @behaviour
+        def fan(self, st, h: Val):
+            self.send(st["a"], Reader.look, h)
+            self.send(st["b"], Reader.look, h)     # fine: val
+            return st
+
+    rt = Runtime(OPTS)
+    rt.declare(GoodFan, 1).declare(Reader, 2).start()
+    r1, r2 = rt.spawn(Reader), rt.spawn(Reader)
+    f = rt.spawn(GoodFan, a=int(r1), b=int(r2))
+    rt.send(f, GoodFan.fan, 9)
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(int(r1))["seen"] == 1
+    assert rt.state_of(int(r2))["seen"] == 1
+
+
+# ---------------- dynamic (host heap) discipline ----------------
+
+def test_heap_iso_unbox_consumes_and_double_take_raises():
+    h = HostHeap()
+    hd = h.box({"payload": 1})
+    assert h.mode(hd) == "iso"
+    assert h.peek(hd) == {"payload": 1}
+    assert h.unbox(hd) == {"payload": 1}
+    with pytest.raises(KeyError):
+        h.unbox(hd)                    # double-take = use-after-send
+    assert h.live == 0
+
+
+def test_heap_val_is_read_only_shared():
+    h = HostHeap()
+    hd = h.box_val((1, 2, 3))
+    assert h.peek(hd) == (1, 2, 3)
+    assert h.peek(hd) == (1, 2, 3)     # shared: peek forever
+    with pytest.raises(CapabilityError, match="shared-immutable"):
+        h.unbox(hd)
+    h.drop(hd)
+    assert h.live == 0
+
+
+def test_heap_tag_is_opaque():
+    h = HostHeap()
+    hd = h.box_tag(object())
+    with pytest.raises(CapabilityError, match="opaque"):
+        h.peek(hd)
+    with pytest.raises(CapabilityError, match="opaque"):
+        h.unbox(hd)
+    h.drop(hd)
+
+
+def test_in_flight_iso_rejects_peek_and_resend():
+    """Use-after-send: once an iso handle rides an Iso parameter, the
+    sender may neither read it nor send it again until delivery."""
+    logs = []
+
+    @actor
+    class HSink:
+        HOST = True
+        got: I32
+
+        @behaviour
+        def recv(self, st, h: Iso):
+            logs.append(int(h))
+            return {**st, "got": st["got"] + 1}
+
+    rt = Runtime(OPTS)
+    rt.declare(HSink, 1).start()
+    sink = rt.spawn(HSink)
+    hd = rt.heap.box(b"bytes")
+    rt.send(sink, HSink.recv, hd)
+    with pytest.raises(CapabilityError, match="use-after-send"):
+        rt.heap.peek(hd)
+    with pytest.raises(CapabilityError, match="aliased move"):
+        rt.send(sink, HSink.recv, hd)
+    assert rt.run(max_steps=32) == 0
+    assert logs == [hd]
+    # Delivery completed the move: the receiver's side may unbox now.
+    assert rt.heap.unbox(hd) == b"bytes"
+
+
+def test_null_sentinel_is_exempt_from_move_discipline():
+    """-1/0 'no handle' sentinels may ride Iso parameters repeatedly
+    (small-int interning must not fake an aliased move), including the
+    clear-to-minus-one consume idiom alongside a sentinel send."""
+
+    @actor
+    class NullFan:
+        a: Ref["Holder"]
+        b: Ref["Holder"]
+        payload: Iso
+        MAX_SENDS = 2
+
+        @behaviour
+        def fan(self, st, _: I32):
+            self.send(st["a"], Holder.take, np.int32(-1))
+            self.send(st["b"], Holder.take, np.int32(-1))
+            return {**st, "payload": np.int32(-1)}
+
+    rt = Runtime(OPTS)
+    rt.declare(NullFan, 1).declare(Holder, 2).start()
+    f = rt.spawn(NullFan)
+    rt.send(f, NullFan.fan, 0)
+    assert rt.run(max_steps=16) == 0
+
+
+def test_failed_send_does_not_poison_handle():
+    """A send that fails validation must leave the handle usable (the
+    in-flight mark happens only after packing succeeds)."""
+
+    @actor
+    class HSink3:
+        HOST = True
+        got: I32
+
+        @behaviour
+        def recv(self, st, h: Iso):
+            return {**st, "got": st["got"] + 1}
+
+    rt = Runtime(OPTS)
+    rt.declare(HSink3, 1).start()
+    sink = rt.spawn(HSink3)
+    hd = rt.heap.box("precious")
+    with pytest.raises(TypeError):
+        rt.send(sink, HSink3.recv, hd, 123)   # wrong arg count
+    assert rt.heap.peek(hd) == "precious"     # NOT poisoned
+    rt.send(sink, HSink3.recv, hd)            # corrected retry works
+    assert rt.run(max_steps=32) == 0
+    assert rt.state_of(sink)["got"] == 1
+
+
+def test_request_exit_before_run_is_honoured():
+    @actor
+    class Idle:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def tick(self, st, v: I32):
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(OPTS)
+    rt.declare(Idle, 1).start()
+    rt.spawn(Idle)
+    rt.request_exit(42)
+    assert rt.run(max_steps=100) == 42
+
+
+def test_val_handle_rides_message_and_stays_peekable():
+    @actor
+    class HSink2:
+        HOST = True
+        got: I32
+
+        @behaviour
+        def recv(self, st, h: Val):
+            return {**st, "got": st["got"] + 1}
+
+    rt = Runtime(OPTS)
+    rt.declare(HSink2, 1).start()
+    sink = rt.spawn(HSink2)
+    hd = rt.heap.box_val("shared")
+    rt.send(sink, HSink2.recv, hd)
+    assert rt.heap.peek(hd) == "shared"   # still readable in flight
+    assert rt.run(max_steps=32) == 0
+    assert rt.state_of(sink)["got"] == 1
+    assert rt.heap.peek(hd) == "shared"

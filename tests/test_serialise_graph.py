@@ -1,0 +1,159 @@
+"""Selective object-graph serialisation (stdlib/serialise.py).
+
+≙ src/libponyrt/gc/serialise.c:33-47 (single object-graph flatten with
+an offset object map) + packages/serialise (auth-token surface). The
+world-checkpoint tests live in test_serialise.py; these cover the
+per-graph sibling: shared substructure, cycles, capability-aware handle
+walking, payload round trips through a real actor send, and the auth
+gates."""
+
+import pytest
+
+from ponyc_tpu import I32, Iso, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.hostmem import CapabilityError, HostHeap
+from ponyc_tpu.stdlib.serialise import (DeserialiseAuth, HandleRef,
+                                        OutputSerialisedAuth,
+                                        SerialiseAuth, Serialised,
+                                        SerialiseError,
+                                        deserialise_from_handle,
+                                        serialise_to_handle)
+
+
+def roundtrip(obj, heap_out=None, heap_in=None):
+    s = Serialised(SerialiseAuth(), obj, heap=heap_out)
+    data = s.output(OutputSerialisedAuth())
+    return Serialised.from_bytes(data).apply(DeserialiseAuth(),
+                                             heap=heap_in)
+
+
+def test_scalars_and_containers():
+    obj = {"a": [1, 2.5, "three", b"\x00\xff", None, True],
+           "b": (7, 8), "c": {"nested": {1: "one"}},
+           "big": 2 ** 80, "s": {3, 1, 2}}
+    got = roundtrip(obj)
+    assert got == obj
+    assert isinstance(got["b"], tuple) and isinstance(got["s"], set)
+
+
+def test_shared_substructure_is_preserved():
+    shared = [1, 2, 3]
+    obj = {"x": shared, "y": shared}
+    got = roundtrip(obj)
+    assert got["x"] == [1, 2, 3]
+    assert got["x"] is got["y"], "diamond collapsed to two copies"
+
+
+def test_cycles_roundtrip():
+    a = {"name": "a"}
+    b = {"name": "b", "peer": a}
+    a["peer"] = b                       # 2-cycle
+    lst = [1]
+    lst.append(lst)                     # self-cycle
+    got = roundtrip({"pair": a, "loop": lst})
+    assert got["pair"]["peer"]["peer"] is got["pair"]
+    assert got["loop"][1] is got["loop"]
+
+
+def test_handle_walk_iso_moves_val_copies_tag_rejects():
+    h = HostHeap()
+    iso_h = h.box({"kind": "iso-payload"})
+    val_h = h.box_val("shared-text")
+    obj = {"moved": HandleRef(iso_h), "copied": HandleRef(val_h)}
+    s = Serialised(SerialiseAuth(), obj, heap=h)
+    # iso target was CONSUMED by the walk (the move rides serialisation)
+    with pytest.raises(KeyError):
+        h.peek(iso_h)
+    # val target survives (shared-immutable copy)
+    assert h.peek(val_h) == "shared-text"
+    h2 = HostHeap()
+    got = s.output(OutputSerialisedAuth())
+    got = Serialised.from_bytes(got).apply(DeserialiseAuth(), heap=h2)
+    assert h2.unbox(got["moved"].handle) == {"kind": "iso-payload"}
+    assert h2.unbox(got["copied"].handle) == "shared-text"
+    # tag refuses: opaque addresses have no readable content
+    tag_h = h.box_tag(object())
+    with pytest.raises(CapabilityError, match="opaque"):
+        Serialised(SerialiseAuth(), HandleRef(tag_h), heap=h)
+
+
+def test_failed_walk_leaves_heap_untouched():
+    """A serialisation error must not half-destroy the caller's graph:
+    iso moves commit only after the whole walk succeeds."""
+
+    class Bad:
+        pass
+
+    h = HostHeap()
+    iso_h = h.box({"keep": "me"})
+    with pytest.raises(SerialiseError):
+        Serialised(SerialiseAuth(), [HandleRef(iso_h), Bad()], heap=h)
+    assert h.peek(iso_h) == {"keep": "me"}    # survived the failure
+
+
+def test_aliased_iso_in_one_graph_rejected():
+    h = HostHeap()
+    iso_h = h.box("x")
+    with pytest.raises(CapabilityError, match="aliased move"):
+        Serialised(SerialiseAuth(),
+                   [HandleRef(iso_h), HandleRef(iso_h)], heap=h)
+    assert h.peek(iso_h) == "x"               # untouched
+
+
+def test_auth_tokens_gate_every_operation():
+    with pytest.raises(TypeError, match="SerialiseAuth"):
+        Serialised(object(), [1])
+    s = Serialised(SerialiseAuth(), [1])
+    with pytest.raises(TypeError, match="OutputSerialisedAuth"):
+        s.output(object())
+    with pytest.raises(TypeError, match="DeserialiseAuth"):
+        s.apply(object())
+
+
+def test_unserialisable_object_rejected():
+    class Custom:
+        pass
+
+    with pytest.raises(SerialiseError, match="unserialisable"):
+        Serialised(SerialiseAuth(), {"bad": Custom()})
+
+
+def test_hostile_buffer_rejected():
+    with pytest.raises(SerialiseError):
+        Serialised.from_bytes(b"XXXX\x01\x00\x00\x00...")
+    with pytest.raises(SerialiseError):
+        Serialised.from_bytes(b"PTSG" + b"\x01\x00\x00\x00"
+                              + b"\x01\x00\x00\x00" + b"not json")
+
+
+def test_graph_rides_actor_message():
+    """The payload use case end to end: serialise a graph, box it iso,
+    send the handle through the runtime to a host actor, receiver
+    deserialises — exactly serialise.c's IPC role."""
+    received = []
+
+    @actor
+    class GraphSink:
+        HOST = True
+        got: I32
+
+        @behaviour
+        def recv(self, st, h: Iso):
+            obj = deserialise_from_handle(DeserialiseAuth(), int(h),
+                                          self.rt.heap)
+            received.append(obj)
+            return {**st, "got": st["got"] + 1}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=2, inject_slots=8))
+    rt.declare(GraphSink, 1).start()
+    sink = rt.spawn(GraphSink)
+    inner = {"deep": [1, 2, {"x": "y"}]}
+    graph = {"payload": inner, "alias": inner}
+    hd = serialise_to_handle(SerialiseAuth(), graph, rt.heap)
+    rt.send(sink, GraphSink.recv, hd)
+    assert rt.run(max_steps=64) == 0
+    assert rt.state_of(sink)["got"] == 1
+    got = received[0]
+    assert got == graph
+    assert got["payload"] is got["alias"]
+    assert rt.heap.live == 0            # bytes handle consumed

@@ -1,0 +1,229 @@
+"""Interactive terminal input (stdlib/term.py: ANSITerm + Readline).
+
+≙ packages/term/ansi_term.pony (escape state machine over stdin bytes),
+readline.pony (line editing, history, tab completion, promise-driven
+prompts), readline_notify.pony — plus the bridge stdin wiring
+(lang/stdfd.c's role). Tests feed bytes directly (the same entry the
+stdin fd subscription calls)."""
+
+import io
+
+from ponyc_tpu.stdlib.term import (ANSINotify, ANSITerm, Readline,
+                                   ReadlineNotify)
+
+
+class KeyLog(ANSINotify):
+    def __init__(self):
+        self.events = []
+
+    def apply(self, term, byte):
+        self.events.append(("byte", byte))
+
+    def up(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("up", ctrl, alt, shift))
+
+    def down(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("down", ctrl, alt, shift))
+
+    def left(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("left", ctrl, alt, shift))
+
+    def right(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("right", ctrl, alt, shift))
+
+    def delete(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("delete", ctrl, alt, shift))
+
+    def home(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("home", ctrl, alt, shift))
+
+    def end_key(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("end", ctrl, alt, shift))
+
+    def page_up(self, ctrl=False, alt=False, shift=False):
+        self.events.append(("pgup", ctrl, alt, shift))
+
+    def fn_key(self, i, ctrl=False, alt=False, shift=False):
+        self.events.append(("fn", i, ctrl, alt, shift))
+
+    def size(self, rows, cols):
+        self.events.append(("size", rows > 0, cols > 0))
+
+    def closed(self):
+        self.events.append(("closed",))
+
+
+def test_escape_state_machine_parses_standard_keys():
+    log = KeyLog()
+    term = ANSITerm(log)
+    log.events.clear()                       # drop the initial size()
+    term.apply(b"a")                         # plain byte
+    term.apply(b"\x1b[A")                    # CSI up
+    term.apply(b"\x1b[1;5C")                 # ctrl-right (mod 5 = 1+4)
+    term.apply(b"\x1b[3~")                   # delete
+    term.apply(b"\x1b[5~")                   # page up
+    term.apply(b"\x1b[15~")                  # F5
+    term.apply(b"\x1bOD")                    # SS3 left
+    term.apply(b"\x1bOP")                    # SS3 PF1 = F1
+    assert log.events == [
+        ("byte", ord("a")),
+        ("up", False, False, False),
+        ("right", True, False, False),
+        ("delete", False, False, False),
+        ("pgup", False, False, False),
+        ("fn", 5, False, False, False),
+        ("left", False, False, False),
+        ("fn", 1, False, False, False),
+    ]
+
+
+def test_split_escape_sequences_across_reads():
+    """A CSI sequence arriving one byte per read must parse the same
+    (partial reads are normal on a pty)."""
+    log = KeyLog()
+    term = ANSITerm(log)
+    log.events.clear()
+    for b in b"\x1b", b"[", b"1", b";", b"2", b"A":
+        term.apply(b)
+    assert log.events == [("up", False, False, True)]     # shift-up
+
+
+def test_bare_escape_passes_through():
+    log = KeyLog()
+    term = ANSITerm(log)
+    log.events.clear()
+    term.apply(b"\x1bq")                     # ESC then plain byte
+    assert log.events == [("byte", 0x1B), ("byte", ord("q"))]
+
+
+class LineSink(ReadlineNotify):
+    def __init__(self, completions=()):
+        self.lines = []
+        self.completions = list(completions)
+        self.reject_after = None
+
+    def apply(self, line, prompt):
+        self.lines.append(line)
+        if self.reject_after is not None and len(
+                self.lines) >= self.reject_after:
+            prompt.reject("done")
+        else:
+            prompt.fulfil("> ")
+
+    def tab(self, line):
+        return [c for c in self.completions if c.startswith(line)]
+
+
+def _readline(completions=()):
+    sink = LineSink(completions)
+    out = io.StringIO()
+    rl = Readline(sink, out)
+    term = ANSITerm(rl, out)
+    term.prompt("> ")                        # unblock with first prompt
+    return sink, out, rl, term
+
+
+def test_readline_basic_line_dispatch_and_echo():
+    sink, out, rl, term = _readline()
+    term.apply(b"hello\n")
+    assert sink.lines == ["hello"]
+    assert "hello" in out.getvalue()
+    term.apply(b"world\r")                   # CR dispatches too
+    assert sink.lines == ["hello", "world"]
+
+
+def test_readline_editing_keys():
+    sink, out, rl, term = _readline()
+    term.apply(b"helo")
+    term.apply(b"\x1b[D")                    # left (cursor at 'o')
+    term.apply(b"l")                         # insert -> "hello"
+    term.apply(b"\x01")                      # ctrl-a home
+    term.apply(b"X")                         # insert at start
+    term.apply(b"\x7f")                      # backspace removes X
+    term.apply(b"\x05")                      # ctrl-e end
+    term.apply(b"\n")
+    assert sink.lines == ["hello"]
+
+
+def test_readline_history_navigation():
+    sink, out, rl, term = _readline()
+    term.apply(b"first\n")
+    term.apply(b"second\n")
+    term.apply(b"\x1b[A")                    # up -> "second"
+    term.apply(b"\n")
+    assert sink.lines == ["first", "second", "second"]
+    term.apply(b"\x1b[A\x1b[A\x1b[A")        # up to the oldest
+    term.apply(b"\n")
+    assert sink.lines[-1] == "first"
+
+
+def test_readline_tab_completion():
+    sink, out, rl, term = _readline(["commit", "checkout"])
+    term.apply(b"com\t")                     # unique -> completes
+    term.apply(b"\n")
+    assert sink.lines == ["commit"]
+    term.apply(b"c\t")                       # ambiguous -> listed
+    assert "commit" in out.getvalue() and "checkout" in out.getvalue()
+    term.apply(b"heckout\n")                 # keep typing after listing
+    assert sink.lines[-1] == "checkout"
+
+
+def test_readline_ctrl_d_on_empty_line_closes():
+    sink, out, rl, term = _readline()
+    term.apply(b"\x04")                      # ctrl-d, empty edit
+    assert term.closed
+
+
+def test_readline_rejected_prompt_closes_terminal():
+    sink, out, rl, term = _readline()
+    sink.reject_after = 1
+    term.apply(b"quit\n")
+    assert term.closed
+
+
+def test_readline_history_persistence(tmp_path):
+    path = str(tmp_path / "history")
+    sink = LineSink()
+    out = io.StringIO()
+    rl = Readline(sink, out, path=path, maxlen=2)
+    term = ANSITerm(rl, out)
+    term.prompt("> ")
+    term.apply(b"one\ntwo\nthree\n")
+    term.dispose()                           # saves history
+    with open(path) as f:
+        assert f.read().splitlines() == ["two", "three"]   # maxlen=2
+    rl2 = Readline(LineSink(), io.StringIO(), path=path, maxlen=2)
+    assert rl2._history == ["two", "three"]
+
+
+def test_readline_utf8_multibyte_input():
+    """Multi-byte UTF-8 arrives byte-at-a-time and must insert ONE
+    character with correct cursor math."""
+    sink, out, rl, term = _readline()
+    term.apply("café".encode("utf-8"))       # é = 2 bytes
+    term.apply(b"\x7f")                      # backspace removes é (1 ch)
+    term.apply("é!".encode("utf-8"))
+    term.apply(b"\n")
+    assert sink.lines == ["café!"]
+
+
+def test_dispose_hooks_run_on_every_close_path():
+    calls = []
+    sink, out, rl, term = _readline()
+    term.add_dispose_hook(lambda: calls.append("hook"))
+    term.apply(b"\x04")                      # ctrl-d on empty line
+    assert term.closed and calls == ["hook"]
+    term.dispose()                           # idempotent
+    assert calls == ["hook"]
+
+
+def test_readline_blocked_until_prompt():
+    sink = LineSink()
+    out = io.StringIO()
+    rl = Readline(sink, out)
+    term = ANSITerm(rl, out)
+    term.apply(b"ignored\n")                 # no prompt yet: blocked
+    assert sink.lines == []
+    term.prompt("> ")
+    term.apply(b"seen\n")
+    assert sink.lines == ["seen"]
