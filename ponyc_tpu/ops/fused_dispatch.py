@@ -15,10 +15,12 @@ scan intermediates.
 
 Eligibility (checked by `eligible()` — everything else falls back to
 the XLA path, same semantics):
-  - no device spawns/destroy/error/sync-construction across the
-    cohort's behaviours (multi-behaviour cohorts are fine: the kernel
-    evaluates every behaviour on the lanes and selects per lane by
-    message id, exactly like the XLA scan);
+  - no device spawns / sync-construction across the cohort's
+    behaviours (slot reservation + newborn init packaging stay on the
+    XLA path); destroy() and error_int() ARE hosted — their flags ride
+    out of the kernel as lane planes exactly like exit. Multi-behaviour
+    cohorts are fine: the kernel evaluates every behaviour on the lanes
+    and selects per lane by message id, exactly like the XLA scan;
   - behaviour body uses only elementwise/lane ops. This is the API
     contract anyway — a behaviour describes ONE actor's reaction, so
     lane-crossing ops (reductions over the cohort) have no defined
@@ -45,19 +47,20 @@ LANE_BLOCK = 1024
 
 
 def eligible(cohort, effects, opts) -> bool:
-    """Structural + trace-discovered preconditions for the fused path."""
+    """Structural + trace-discovered preconditions for the fused path.
+    destroy/error are hosted (lane-plane outputs); spawning still needs
+    the XLA path's reservation machinery."""
     return (len(cohort.behaviours) >= 1
             and not cohort.spawns
-            and not effects["destroy"]
-            and not effects["error"]
             and not effects["sync_init"])
 
 
 def _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms, lanes):
     """The planar behaviour evaluator for eligible cohorts: the SAME
     shared core as the XLA path (engine.eval_behaviour — one
-    implementation, so the two formulations cannot drift), minus the
-    spawn/destroy/error packaging eligibility excludes."""
+    implementation, so the two formulations cannot drift), emitting
+    exit/yield/destroy/error lane planes; only the spawn packaging
+    eligibility excludes is absent."""
 
     def branch(st, payload, ids_vec):
         from ..runtime.engine import eval_behaviour
@@ -66,11 +69,14 @@ def _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms, lanes):
             field_specs=field_specs, field_dtypes=field_dtypes,
             lanes=lanes, max_sends=ms)
         b = jnp.bool_
+        bc = lambda v, d: jnp.broadcast_to(       # noqa: E731
+            jnp.asarray(v, d), (lanes,))
         return (st2, tgts, words,
-                jnp.broadcast_to(jnp.asarray(ctx.exit_flag, b), (lanes,)),
-                jnp.broadcast_to(jnp.asarray(ctx.exit_code, jnp.int32),
-                                 (lanes,)),
-                jnp.broadcast_to(jnp.asarray(ctx.yield_flag, b), (lanes,)))
+                bc(ctx.exit_flag, b), bc(ctx.exit_code, jnp.int32),
+                bc(ctx.yield_flag, b),
+                bc(ctx.destroy_flag, b),
+                bc(ctx.error_flag, b), bc(ctx.error_code, jnp.int32),
+                bc(ctx.error_loc, jnp.int32))
 
     return branch
 
@@ -81,9 +87,11 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
                          noyield: bool, interpret: bool):
     """Returns fn(fields_tuple, buf, head, n_run, ids) →
     (new_fields_tuple, out_tgt [batch*ms*rows], out_words [w1, b*ms*rows],
-    new_head [rows], nproc [rows], nbad [rows], ef [rows], ec [rows])
+    new_head [rows], nproc [rows], nbad [rows], ef [rows], ec [rows],
+    ds [rows], erf [rows], erc [rows], erl [rows])
     with EXACTLY the XLA path's semantics (engine busy_fn ordering:
-    entry (k, m, r) flattens k-major, then send slot, then lane)."""
+    entry (k, m, r) flattens k-major, then send slot, then lane; exit =
+    first wins, error = latest wins, destroy ORs across the batch)."""
     w1 = 1 + msg_words
     lb = min(LANE_BLOCK, rows)
     assert rows % lb == 0, (rows, lb)
@@ -99,10 +107,11 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         rest = refs[nf + 1 + nf:]
         if ms:
             (tgt_ref, words_ref, nh_ref, np_ref, nb_ref, ef_ref,
-             ec_ref) = rest
+             ec_ref, ds_ref, erf_ref, erc_ref, erl_ref) = rest
         else:                         # send-less cohort: no outbox planes
             tgt_ref = words_ref = None
-            nh_ref, np_ref, nb_ref, ef_ref, ec_ref = rest
+            (nh_ref, np_ref, nb_ref, ef_ref, ec_ref, ds_ref, erf_ref,
+             erc_ref, erl_ref) = rest
         head = head_ref[0]
         nrun = nrun_ref[0]
         ids = ids_ref[0]
@@ -111,6 +120,10 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         stopped = jnp.zeros((lb,), jnp.bool_)
         ef = jnp.zeros((lb,), jnp.bool_)
         ec = jnp.zeros((lb,), jnp.int32)
+        dstr = jnp.zeros((lb,), jnp.bool_)
+        erf = jnp.zeros((lb,), jnp.bool_)
+        erc = jnp.zeros((lb,), jnp.int32)
+        erl = jnp.zeros((lb,), jnp.int32)
         nproc = jnp.zeros((lb,), jnp.int32)
         nbad = jnp.zeros((lb,), jnp.int32)
         consumed = jnp.zeros((lb,), jnp.int32)
@@ -132,8 +145,8 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
                          for _ in range(ms)]
             for j, branch in enumerate(branches):
                 take = do & (local == j)
-                st2, tgts, words, bef, bec, byf = branch(st, msg[1:],
-                                                         ids)
+                (st2, tgts, words, bef, bec, byf, bds, berf, berc,
+                 berl) = branch(st, msg[1:], ids)
                 for i, name in enumerate(field_names):
                     st[name] = jnp.where(take, st2[name], st[name])
                 for m in range(ms):
@@ -143,6 +156,13 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
                 new_ef = take & bef
                 ec = jnp.where(new_ef & ~ef, bec, ec)
                 ef = ef | new_ef
+                dstr = dstr | (take & bds)
+                # Error: the LATEST error's code/loc wins (the XLA
+                # scan's jnp.where(erf_n, ...) ordering).
+                n_err = take & berf
+                erc = jnp.where(n_err, berc, erc)
+                erl = jnp.where(n_err, berl, erl)
+                erf = erf | n_err
                 if not noyield:
                     stopped = stopped | (take & byf)
             for m in range(ms):
@@ -159,6 +179,10 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         nb_ref[0] = nbad
         ef_ref[0] = ef.astype(jnp.int32)
         ec_ref[0] = ec
+        ds_ref[0] = dstr.astype(jnp.int32)
+        erf_ref[0] = erf.astype(jnp.int32)
+        erc_ref[0] = erc
+        erl_ref[0] = erl
 
     @functools.partial(jax.jit)
     def run(fields, buf, head, n_run, ids):
@@ -178,12 +202,12 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         out_specs = (
             [pl.BlockSpec((1, lb), lambda i: (0, i))] * nf
             + outbox_specs
-            + [pl.BlockSpec((1, lb), lambda i: (0, i))] * 5)
+            + [pl.BlockSpec((1, lb), lambda i: (0, i))] * 9)
         out_shape = (
             [jax.ShapeDtypeStruct((1, rows), fields[i].dtype)
              for i in range(nf)]
             + outbox_shape
-            + [jax.ShapeDtypeStruct((1, rows), jnp.int32)] * 5)
+            + [jax.ShapeDtypeStruct((1, rows), jnp.int32)] * 9)
         outs = pl.pallas_call(
             kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
             out_shape=out_shape, interpret=interpret,
@@ -204,8 +228,10 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
             rest_out = outs[nf:]
             out_tgt = jnp.full((e,), -1, jnp.int32)
             out_words = jnp.zeros((w1, e), jnp.int32)
-        new_head, nproc, nbad, ef, ec = (o[0] for o in rest_out)
+        (new_head, nproc, nbad, ef, ec, ds, erf, erc, erl) = (
+            o[0] for o in rest_out)
         return (new_fields, out_tgt, out_words, new_head, nproc, nbad,
-                ef.astype(jnp.bool_), ec)
+                ef.astype(jnp.bool_), ec, ds.astype(jnp.bool_),
+                erf.astype(jnp.bool_), erc, erl)
 
     return run

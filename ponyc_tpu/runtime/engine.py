@@ -282,8 +282,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
         from ..ops import mailbox_kernel as mk
         if rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0:
             # Probe-trace every branch so `effects` is discovered BEFORE
-            # the path decision (the fused kernel cannot host destroy/
-            # error/sync-construction bookkeeping).
+            # the path decision (the fused kernel hosts destroy/error as
+            # lane planes but cannot host sync-construction packaging).
             for br in branches:
                 jax.eval_shape(
                     br,
@@ -413,17 +413,15 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 kernel_fn, fnames = fused
                 fields = tuple(type_state_rows[f] for f in fnames)
                 (nf_out, out_tgt, out_words, new_head, nproc_l, nbad_l,
-                 ef_l, ec_l) = kernel_fn(fields, buf_rows, head_rows,
-                                         n_run, ids)
+                 ef_l, ec_l, ds_l, erf_l, erc_l, erl_l) = kernel_fn(
+                    fields, buf_rows, head_rows, n_run, ids)
                 stf = dict(zip(fnames, nf_out))
                 any_exit = jnp.any(ef_l)
                 code = ec_l[jnp.argmax(ef_l)]
-                zb = jnp.zeros((rows,), jnp.bool_)
-                zi = jnp.zeros((rows,), jnp.int32)
                 return (stf, out_tgt, out_words, new_head, any_exit,
                         code, jnp.sum(nproc_l), jnp.sum(nbad_l),
-                        tuple(), tuple(), jnp.bool_(False), zb, zb, zi,
-                        zi)
+                        tuple(), tuple(), jnp.bool_(False), ds_l, erf_l,
+                        erc_l, erl_l)
             if opts.pallas:          # gate BEFORE importing pallas/mosaic
                 from ..ops import mailbox_kernel as mk
             if opts.pallas and (rows <= mk.LANE_BLOCK

@@ -136,6 +136,67 @@ def test_multi_behaviour_cohort_under_fused_kernel():
     assert res[True][1] == [4, 4, 2]
 
 
+def test_destroy_under_fused_kernel():
+    """destroy() rides out of the fused kernel as a lane plane: slots
+    free identically to the XLA path (round-4 eligibility extension —
+    real programs with lifecycle now qualify for the north-star
+    kernel)."""
+    @actor
+    class Ephemeral:
+        n: I32
+        MAX_SENDS = 0
+
+        @behaviour
+        def die(self, st, v: I32):
+            self.destroy(when=v > 0)
+            return {**st, "n": st["n"] + 1}
+
+    res = {}
+    for fused in (False, True):
+        rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, max_sends=0,
+                                    msg_words=1, spill_cap=64,
+                                    inject_slots=16, pallas_fused=fused))
+        rt.declare(Ephemeral, 4).start()
+        ids = rt.spawn_many(Ephemeral, 4)
+        for i in ids:
+            rt.send(int(i), Ephemeral.die, 1 if int(i) % 2 == 0 else 0)
+        assert rt.run() == 0
+        alive = np.asarray(rt.state.alive)[:4]
+        res[fused] = list(alive)
+    assert res[True] == res[False]
+    assert sum(res[True]) == 2               # odd ids survived
+
+
+def test_error_int_under_fused_kernel():
+    """error_int() codes/locs ride out of the fused kernel exactly as
+    on the XLA path (fork int-coded errors, pony.h:622-665)."""
+    @actor
+    class Errs:
+        n: I32
+        MAX_SENDS = 0
+
+        @behaviour
+        def go(self, st, v: I32):
+            self.error_int(v, when=v > 0)
+            return {**st, "n": st["n"] + 1}
+
+    res = {}
+    for fused in (False, True):
+        rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=2, max_sends=0,
+                                    msg_words=1, spill_cap=64,
+                                    inject_slots=16, pallas_fused=fused))
+        rt.declare(Errs, 2).start()
+        a, b = rt.spawn_many(Errs, 2)
+        rt.send(int(a), Errs.go, 41)
+        rt.send(int(a), Errs.go, 42)     # latest error wins
+        rt.send(int(b), Errs.go, 0)      # no error
+        assert rt.run() == 0
+        res[fused] = (rt.last_error(int(a)), rt.last_error(int(b)),
+                      rt.state_of(int(a))["n"], rt.state_of(int(b))["n"])
+    assert res[True] == res[False]
+    assert res[True][0] == 42 and res[True][2] == 2
+
+
 @pytest.mark.parametrize("fused", [False, True])
 def test_gups_xor_conservation_under_fused(fused):
     """The gups random-access workload (two cohorts, one sending into a
