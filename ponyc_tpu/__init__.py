@@ -12,8 +12,8 @@ See SURVEY.md at the repo root for the full mapping to the reference.
 """
 
 from .api import (Actor, Bool, Context, F32, I8, I16, I32, Iso, Ref,
-                  Tag, U8, U16, U32, Val, VecF32, VecI32, actor, be,
-                  behaviour)
+                  Tag, TypeParam, U8, U16, U32, Val, VecF32, VecI32,
+                  actor, be, behaviour)
 from .config import RuntimeOptions, options_from_env, strip_runtime_flags
 from .program import Program
 from .runtime.runtime import (Runtime, SpawnCapacityError,
@@ -23,8 +23,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Actor", "Bool", "Context", "F32", "I8", "I16", "I32", "Iso",
-    "Ref", "Tag", "U8", "U16", "U32", "Val", "VecF32", "VecI32",
-    "actor", "be",
+    "Ref", "Tag", "TypeParam", "U8", "U16", "U32", "Val", "VecF32",
+    "VecI32", "actor", "be",
     "behaviour", "RuntimeOptions", "options_from_env",
     "strip_runtime_flags", "Program", "Runtime", "SpillOverflowError",
     "SpawnCapacityError",

@@ -1,0 +1,166 @@
+"""Unified CLI driver — ``python -m ponyc_tpu <command>``.
+
+≙ the reference's ``ponyc`` driver (src/ponyc/main.c:111: option
+processing via the shared runtime parser, then compile/run), adapted to
+a trace-time framework: there is no ahead-of-time binary, so "compile
+and run a package" becomes "strip the --pony* runtime flags, set the
+backend, and execute the program script" — with the same one-entry-point
+ergonomics the reference gets from its binary.
+
+Commands:
+  run <script.py> [args...]   strip --pony* flags into the environment
+                              (config.strip_runtime_flags), pick a
+                              backend (platforms.auto_backend), exec the
+                              script with the remaining argv.
+  bench [args...]             the headline benchmark (bench.py).
+  test [pytest args...]       the test suite (≙ ponytest aggregate).
+  doc <module[:ATTR]> [-o D]  generate docs for actor types reachable
+                              from a module (≙ docgen pass, docgen.c).
+  version                     print version + backend info.
+
+Runtime flags accepted anywhere in `run` argv, exactly like the
+reference stripping --pony* before the app sees argv (start.c:185-261):
+  python -m ponyc_tpu run app.py --ponymailboxcap=128 --input data.txt
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+
+
+def _usage(code: int = 2) -> int:
+    print(__doc__, file=sys.stderr)
+    return code
+
+
+def cmd_run(argv) -> int:
+    # --safe pkg1:pkg2 / --safe=pkg1:pkg2 (≙ ponyc --safe,
+    # package.c:685-692): restrict FFI-reaching packages for the
+    # program being run.
+    cleaned = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--safe":
+            if i + 1 >= len(argv):
+                print("ponyc_tpu run: --safe needs a value "
+                      "(e.g. --safe files:net)", file=sys.stderr)
+                return 2
+            os.environ["PONY_TPU_SAFE"] = argv[i + 1]
+            i += 2
+            continue
+        if a.startswith("--safe="):
+            os.environ["PONY_TPU_SAFE"] = a[len("--safe="):]
+            i += 1
+            continue
+        cleaned.append(a)
+        i += 1
+    from .config import strip_runtime_flags
+    opts, rest = strip_runtime_flags(cleaned)
+    if not rest:
+        print("ponyc_tpu run: missing script path", file=sys.stderr)
+        return 2
+    # Hand the parsed runtime options to the script via the env channel
+    # every Runtime() constructor honours (config.options_from_env), so
+    # `run app.py --ponybatch 4` configures app.py's runtime without the
+    # script doing anything (≙ pony_init eating --pony* from argv).
+    import dataclasses
+    defaults = type(opts)()
+    for f in dataclasses.fields(opts):
+        v = getattr(opts, f.name)
+        if v != getattr(defaults, f.name) and v is not None:
+            os.environ["PONY_TPU_" + f.name.upper()] = str(v)
+    script, *args = rest
+    if not os.path.exists(script):
+        print(f"ponyc_tpu run: no such script: {script}", file=sys.stderr)
+        return 2
+    from .platforms import auto_backend
+    auto_backend()
+    sys.argv = [script] + args
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+def cmd_bench(argv) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(root, "bench.py")
+    if not os.path.exists(bench):
+        print("ponyc_tpu bench: bench.py not found (installed package "
+              "without the repo harness)", file=sys.stderr)
+        return 2
+    return subprocess.call([sys.executable, bench] + list(argv))
+
+
+def cmd_test(argv) -> int:
+    import pytest
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(root, "tests")
+    target = [tests] if os.path.isdir(tests) else ["--pyargs", "ponyc_tpu"]
+    return pytest.main(target + list(argv))
+
+
+def cmd_doc(argv) -> int:
+    if not argv:
+        print("ponyc_tpu doc: missing module[:ATTR]", file=sys.stderr)
+        return 2
+    out_dir = "docs_out"
+    if "-o" in argv:
+        i = argv.index("-o")
+        out_dir = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    import importlib
+
+    from .api import ActorTypeMeta
+    from .docgen import document_types
+    modname, _, attr = argv[0].partition(":")
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(modname)
+    objs = [getattr(mod, attr)] if attr else [
+        v for v in vars(mod).values() if isinstance(v, ActorTypeMeta)]
+    if not objs:
+        print(f"ponyc_tpu doc: no actor types in {modname}",
+              file=sys.stderr)
+        return 1
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, modname.replace(".", "_") + ".md")
+    with open(path, "w") as f:
+        f.write(document_types(*objs, title=modname))
+    print(path)
+    return 0
+
+
+def cmd_version(_argv) -> int:
+    from . import __version__
+    print(f"ponyc_tpu {__version__}")
+    try:
+        from .platforms import probe_accelerator
+        plat, err = probe_accelerator(10.0)
+        print(f"backend: {plat or 'cpu'}"
+              + (f" (accelerator unavailable: {err})" if err else ""))
+    except Exception as e:                     # noqa: BLE001
+        print(f"backend probe failed: {e}")
+    return 0
+
+
+COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
+            "doc": cmd_doc, "version": cmd_version}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        return _usage(0 if argv else 2)
+    cmd = COMMANDS.get(argv[0])
+    if cmd is None:
+        print(f"ponyc_tpu: unknown command {argv[0]!r} "
+              f"(expected one of {', '.join(COMMANDS)})", file=sys.stderr)
+        return 2
+    return cmd(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 
 from .ops import pack
 from .ops.pack import (Bool, F32, I8, I16, I32, Iso, Ref, Tag,  # noqa
-                       U8, U16, U32, Val, VecF32, VecI32)  # re-exported
+                       TypeParam, U8, U16, U32, Val, VecF32,
+                       VecI32)  # re-exported
 
 
 class BehaviourDef:
@@ -140,7 +141,84 @@ class ActorTypeMeta(type):
         # each runnable actor reserves; a step that exceeds it raises
         # SpawnCapacityError (safe, no corruption).
         cls.SPAWN_DISPATCHES = ns.get("SPAWN_DISPATCHES", None)
+        # Generic actor types (≙ formal type parameters; reify.c):
+        # collect TypeParams across fields + behaviour args in first-
+        # appearance order. Non-empty → the class must be reified
+        # (Cls[Concrete]) before declare/spawn.
+        all_specs = list(fields.values())
+        for b in behaviours:
+            all_specs.extend(b.arg_specs)
+        cls._type_params = pack.type_params_of(all_specs)
+        cls._reifications = {}
         return cls
+
+    def __getitem__(cls, item):
+        """Reify a generic actor type: Cell[I32] substitutes the type
+        parameters and yields a CONCRETE actor type with its own cohort
+        and behaviour ids (≙ reify.c — each reification is its own
+        type; reach.c only ever sees concrete ones). Reifications are
+        cached so Cell[I32] is Cell[I32]."""
+        params = cls._type_params
+        if not params:
+            raise TypeError(f"{cls.__name__} is not generic "
+                            "(no TypeParam annotations)")
+        args = item if isinstance(item, tuple) else (item,)
+        args = tuple(pack.normalize_annotation(a)
+                     if not isinstance(a, ActorTypeMeta) else a
+                     for a in args)
+        if len(args) != len(params):
+            raise TypeError(
+                f"{cls.__name__} takes {len(params)} type argument(s) "
+                f"({', '.join(p.name for p in params)}), got {len(args)}")
+        # Cache key: actor/marker CLASSES key by object identity (two
+        # distinct classes sharing a name must not collide); spec
+        # instances key by their canonical name.
+        def _key_of(a):
+            if isinstance(a, type):
+                return a
+            if isinstance(a, pack._RefTo) and not isinstance(a.target,
+                                                             str):
+                return ("Ref", a.target)
+            return a.__name__ if hasattr(a, "__name__") else str(a)
+        key = tuple(_key_of(a) for a in args)
+        hit = cls._reifications.get(key)
+        if hit is not None:
+            return hit
+        mapping = dict(zip(params, args))
+        disp = tuple(a.__name__ if hasattr(a, "__name__") else str(a)
+                     for a in args)
+        name = f"{cls.__name__}[{', '.join(disp)}]"
+        ns = {"__annotations__": {}, "__qualname__": name}
+        for attr in ("BATCH", "PRIORITY", "HOST", "TAG", "SPAWNS",
+                     "SPAWN_DISPATCHES", "MAX_SENDS"):
+            if attr in cls.__dict__:
+                ns[attr] = cls.__dict__[attr]
+        new = ActorTypeMeta(name, (Actor,), ns)
+        new.__name__ = name
+        new._fields = {k: pack.substitute(s, mapping)
+                       for k, s in cls._fields.items()}
+        behaviours = []
+        for b in cls._behaviours:
+            copy = BehaviourDef(b.fn)
+            # Substitute from the CURRENT class's specs (b.arg_specs),
+            # not the freshly re-derived signature specs: re-reifying a
+            # partial application (Cell[U][I32]) must start from U, not
+            # from the template's original parameter.
+            copy.arg_specs = tuple(pack.substitute(s, mapping)
+                                   for s in b.arg_specs)
+            copy.actor_type = new
+            setattr(new, copy.name, copy)
+            behaviours.append(copy)
+        new._behaviours = behaviours
+        # Recompute from the SUBSTITUTED specs: a type argument that is
+        # itself a TypeParam (partial application, Cell[U]) leaves the
+        # result generic — it must still refuse declare().
+        sub_specs = list(new._fields.values())
+        for b in behaviours:
+            sub_specs.extend(b.arg_specs)
+        new._type_params = pack.type_params_of(sub_specs)
+        cls._reifications[key] = new
+        return new
 
     @property
     def field_specs(cls):

@@ -39,10 +39,10 @@ from ..native import sockets as S
 
 class _Conn:
     __slots__ = ("fd", "sub", "owner", "on_connect", "on_data", "on_closed",
-                 "outbuf", "outbuf_len", "connecting", "closed")
+                 "outbuf", "outbuf_len", "connecting", "closed", "tls")
 
     def __init__(self, fd, owner, on_connect, on_data, on_closed,
-                 connecting):
+                 connecting, tls=None):
         self.fd = fd
         self.sub = None
         self.owner = owner
@@ -53,6 +53,7 @@ class _Conn:
         self.outbuf_len = 0
         self.connecting = connecting
         self.closed = False
+        self.tls = tls        # net.tls._TLSState or None (≙ ssl.c hooks)
 
 
 class Net:
@@ -84,7 +85,10 @@ class Net:
     # -- listeners (≙ TCPListener + pony_os_listen_tcp) --
     def listen_tcp(self, host: str, port: int, owner: int, *,
                    on_accept: BehaviourDef, on_data: BehaviourDef,
-                   on_closed: BehaviourDef, backlog: int = 64) -> int:
+                   on_closed: BehaviourDef, backlog: int = 64,
+                   tls=None) -> int:
+        """`tls=TLSServerConfig(...)` upgrades every accepted connection
+        to TLS (net/tls.py ≙ the ssl.c hook surface)."""
         self._check(on_accept, 1, "on_accept")
         self._check(on_data, 3, "on_data")
         self._check(on_closed, 1, "on_closed")
@@ -94,7 +98,7 @@ class Net:
         sub = self.bridge.fd_callback(fd, lambda ev: self._accept_ready(lid),
                                       read=True, noisy=True)
         self._listeners[lid] = (fd, sub, owner,
-                                (on_accept, on_data, on_closed))
+                                (on_accept, on_data, on_closed), tls)
         return lid
 
     def listen_port(self, lid: int) -> int:
@@ -109,31 +113,39 @@ class Net:
         ent = self._listeners.get(lid)
         if ent is None:
             return
-        fd, _sub, owner, (on_accept, on_data, on_closed) = ent
+        fd, _sub, owner, (on_accept, on_data, on_closed), tls_cfg = ent
         while True:
             nfd = S.accept(fd)
             if nfd is None:
                 break
+            tls = tls_cfg.make() if tls_cfg is not None else None
             cid = self._register_conn(nfd, owner, None, on_data, on_closed,
-                                      connecting=False)
+                                      connecting=False, tls=tls)
+            if tls is not None:
+                tls.start()                     # await ClientHello
+                self._tls_pump(cid, self._conns[cid])
             self.rt.send(owner, on_accept, cid)
 
     # -- connections (≙ TCPConnection + pony_os_connect_tcp) --
     def connect_tcp(self, host: str, port: int, owner: int, *,
                     on_connect: BehaviourDef, on_data: BehaviourDef,
-                    on_closed: BehaviourDef) -> int:
+                    on_closed: BehaviourDef, tls=None) -> int:
+        """`tls=TLSClientConfig(...)`: on_connect fires AFTER the TLS
+        handshake (err=0), or err=-1 on handshake failure."""
         self._check(on_connect, 2, "on_connect")
         self._check(on_data, 3, "on_data")
         self._check(on_closed, 1, "on_closed")
         fd = S.connect_tcp(host, port)
         return self._register_conn(fd, owner, on_connect, on_data,
-                                   on_closed, connecting=True)
+                                   on_closed, connecting=True,
+                                   tls=tls.make() if tls else None)
 
     def _register_conn(self, fd, owner, on_connect, on_data, on_closed,
-                       *, connecting) -> int:
+                       *, connecting, tls=None) -> int:
         cid = self._next
         self._next += 1
-        c = _Conn(fd, owner, on_connect, on_data, on_closed, connecting)
+        c = _Conn(fd, owner, on_connect, on_data, on_closed, connecting,
+                  tls)
         # A connecting socket arms write interest to learn the outcome.
         c.sub = self.bridge.fd_callback(
             fd, lambda ev: self._conn_ready(cid, ev),
@@ -149,11 +161,17 @@ class Net:
             if c.connecting:
                 c.connecting = False
                 err = S.connect_result(c.fd)
-                if c.on_connect is not None:
-                    self.rt.send(c.owner, c.on_connect, cid, err)
-                if err != 0:
+                if err != 0:          # TCP failed (before TLS, if any)
+                    if c.on_connect is not None:
+                        self.rt.send(c.owner, c.on_connect, cid, err)
                     self._teardown(cid, notify=False)
                     return
+                if c.tls is None:
+                    if c.on_connect is not None:
+                        self.rt.send(c.owner, c.on_connect, cid, err)
+                else:
+                    c.tls.start()     # ClientHello → outbuf
+                    self._tls_pump(cid, c)
                 self._arm(c)
             if c.outbuf:
                 self._flush(cid, c)
@@ -166,12 +184,57 @@ class Net:
                 if data == b"":       # orderly EOF
                     self._teardown(cid, notify=True)
                     return
+                if c.tls is not None:
+                    c.tls.feed(data)
+                    if not self._tls_pump(cid, c):
+                        return        # handshake failure tore down
+                    app = c.tls.read_app()
+                    if app:
+                        h = self.rt.heap.box(app)
+                        self.rt.send(c.owner, c.on_data, cid, h, len(app))
+                    if c.tls.failed and not self._tls_pump(cid, c):
+                        return        # record failure (bad MAC …)
+                    continue
                 h = self.rt.heap.box(data)
                 self.rt.send(c.owner, c.on_data, cid, h, len(data))
                 # Edge-triggered subscription: always drain to EAGAIN.
             return
         if ev.kind == native.FD_HUP:
             self._teardown(cid, notify=True)
+
+    def _tls_pump(self, cid: int, c: _Conn) -> bool:
+        """Move the record layer forward: transmit pending ciphertext,
+        complete the handshake (flush pre-handshake plaintext, deliver
+        the deferred on_connect), surface failures. False = torn down."""
+        tls = c.tls
+        if tls.failed:
+            if (not tls.done and c.on_connect is not None
+                    and not tls.notified):
+                # Handshake never completed: the client learns via
+                # on_connect(-1); on_closed would be about a connection
+                # it was never told is up.
+                tls.notified = True
+                self.rt.send(c.owner, c.on_connect, cid, -1)
+                self._teardown(cid, notify=False)
+            else:
+                # Established connection died (record failure) — or a
+                # server-side handshake failure on a conn the owner
+                # already saw via on_accept: on_closed either way.
+                self._teardown(cid, notify=True)
+            return False
+        if tls.done and tls.pending_app:
+            tls.flush_pending()
+        out = tls.take_out()
+        if out:
+            c.outbuf.append(out)
+            c.outbuf_len += len(out)
+            if not c.connecting:
+                self._flush(cid, c)
+        if tls.done and not tls.notified:
+            tls.notified = True
+            if c.on_connect is not None:
+                self.rt.send(c.owner, c.on_connect, cid, 0)
+        return True
 
     def _arm(self, c: _Conn) -> None:
         self.bridge.loop.fd_interest(c.sub, read=True,
@@ -208,6 +271,14 @@ class Net:
         c = self._conns.get(cid)
         if c is None or c.closed:
             raise KeyError(f"connection {cid} is closed")
+        if c.tls is not None:
+            # Plaintext → record layer; ciphertext rides the outbuf.
+            for ch in chunks:
+                ch = bytes(ch)
+                if ch:
+                    c.tls.write_app(ch)
+            self._tls_pump(cid, c)
+            return
         for ch in chunks:
             ch = bytes(ch)
             if ch:
@@ -253,7 +324,7 @@ class Net:
         ent = self._listeners.pop(lid, None)
         if ent is None:
             return
-        fd, sub, _owner, _b = ent
+        fd, sub, _owner, _b, _tls = ent
         self.bridge.unsubscribe(sub)
         S.close(fd)
 

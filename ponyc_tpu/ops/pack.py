@@ -112,6 +112,87 @@ class Ref:
         return _RefTo(item)
 
 
+class TypeParam:
+    """A type parameter for generic actor types (≙ the reference's
+    formal type parameters; reify.c substitutes them at instantiation).
+
+        T = TypeParam("T")
+
+        @actor
+        class Cell:
+            value: T
+            @behaviour
+            def put(self, st, v: T): ...
+
+        IntCell = Cell[I32]        # reified (api.ActorTypeMeta)
+
+    A generic (unreified) actor type cannot be declared/spawned — its
+    layout is unknown until every parameter is substituted, exactly as
+    the reference only code-gens reified types (reach.c walks concrete
+    reifications)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = str(name)
+
+    @property
+    def __name__(self) -> str:
+        return self.name
+
+    def __repr__(self):
+        return f"TypeParam({self.name!r})"
+
+    # Identity is the NAME (two TypeParam("A") spellings are the same
+    # formal parameter — ≙ the reference resolving type params by name
+    # within a type's scope).
+    def __eq__(self, other):
+        return isinstance(other, TypeParam) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("TypeParam", self.name))
+
+
+def substitute(spec, mapping):
+    """Reification: replace TypeParams inside a spec (reify.c's type
+    substitution, flattened to this framework's spec grammar)."""
+    if isinstance(spec, TypeParam):
+        try:
+            return mapping[spec]
+        except KeyError:
+            raise TypeError(
+                f"unbound type parameter {spec.name!r}") from None
+    if isinstance(spec, _RefTo) and isinstance(spec.target, TypeParam):
+        got = mapping.get(spec.target)
+        if got is None:
+            raise TypeError(f"unbound type parameter "
+                            f"{spec.target.name!r} in {spec!r}")
+        # Ref[T] reifies to a typed ref of the argument, which must
+        # itself be an actor type (or its name).
+        if isinstance(got, _RefTo):
+            return got
+        if isinstance(got, str) or isinstance(got, type):
+            return _RefTo(got)
+        raise TypeError(
+            f"Ref[{spec.target.name}] needs an actor type argument, "
+            f"got {got!r}")
+    return spec
+
+
+def type_params_of(specs) -> tuple:
+    """Ordered first-appearance TypeParams across an iterable of specs."""
+    seen = []
+    for spec in specs:
+        p = None
+        if isinstance(spec, TypeParam):
+            p = spec
+        elif isinstance(spec, _RefTo) and isinstance(spec.target, TypeParam):
+            p = spec.target
+        if p is not None and p not in seen:
+            seen.append(p)
+    return tuple(seen)
+
+
 class _CapSpec:
     """Host-payload capability annotation: Iso / Val / Tag.
 
@@ -285,7 +366,7 @@ _MARKERS = (I32, F32, Bool, Ref, U32, I16, U16, I8, U8)
 def normalize_annotation(ann):
     """Map a user annotation to a marker class (or typed-ref / vector /
     capability instance)."""
-    if isinstance(ann, (_RefTo, _VecSpec, _CapSpec)):
+    if isinstance(ann, (_RefTo, _VecSpec, _CapSpec, TypeParam)):
         return ann
     if isinstance(ann, str) and ann in ("Iso", "Val", "Tag"):
         return {"Iso": Iso, "Val": Val, "Tag": Tag}[ann]

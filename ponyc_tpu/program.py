@@ -113,6 +113,13 @@ class Program:
             raise RuntimeError("Program already finalized")
         if not isinstance(atype, ActorTypeMeta):
             raise TypeError(f"{atype!r} is not an actor type (use @actor)")
+        if getattr(atype, "_type_params", ()):
+            params = ", ".join(p.name for p in atype._type_params)
+            raise TypeError(
+                f"{atype.__name__} is generic over [{params}] — declare "
+                f"a reification (e.g. {atype.__name__}[I32]) instead; "
+                "only concrete types have a layout (≙ reify.c: codegen "
+                "sees reified types only)")
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self._declared.append((atype, capacity))

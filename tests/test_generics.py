@@ -1,0 +1,197 @@
+"""Parametric actor types + reification (≙ reference generics,
+src/libponyc/type/reify.c: formal type parameters substituted at
+instantiation; codegen only ever sees concrete reifications)."""
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (F32, I32, Ref, Runtime, RuntimeOptions, TypeParam,
+                       actor, behaviour)
+
+T = TypeParam("T")
+
+OPTS = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=2,
+                      inject_slots=8)
+
+
+@actor
+class Cell:
+    """A generic storage cell: Cell[I32], Cell[F32]."""
+    value: T
+
+    @behaviour
+    def put(self, st, v: T):
+        return {**st, "value": v}
+
+
+@actor
+class Pair:
+    """Two parameters."""
+    a: TypeParam("A")
+    b: TypeParam("B")
+
+    @behaviour
+    def set_both(self, st, x: TypeParam("A"), y: TypeParam("B")):
+        return {**st, "a": x, "b": y}
+
+
+def test_generic_type_cannot_be_declared():
+    rt = Runtime(OPTS)
+    with pytest.raises(TypeError, match="generic over"):
+        rt.declare(Cell, 2)
+
+
+def test_reifications_are_cached_and_distinct():
+    assert Cell[I32] is Cell[I32]
+    assert Cell[I32] is not Cell[F32]
+    assert Cell[I32].__name__ == "Cell[I32]"
+    assert Cell[F32].field_specs["value"].__name__ == "F32"
+    # behaviour specs substituted per reification
+    assert Cell[I32].put.arg_specs[0].__name__ == "I32"
+    assert Cell[F32].put.arg_specs[0].__name__ == "F32"
+    # the generic template is untouched
+    assert Cell._type_params and Cell.put.arg_specs[0] is T
+
+
+def test_wrong_arity_rejected():
+    with pytest.raises(TypeError, match="takes 1 type argument"):
+        Cell[I32, F32]
+    with pytest.raises(TypeError, match="not generic"):
+        Cell[I32][I32]
+
+
+def test_two_reifications_run_side_by_side():
+    IntCell, FloatCell = Cell[I32], Cell[F32]
+    rt = Runtime(OPTS)
+    rt.declare(IntCell, 2).declare(FloatCell, 2).start()
+    ic = rt.spawn(IntCell)
+    fc = rt.spawn(FloatCell)
+    rt.send(ic, IntCell.put, 41)
+    rt.send(fc, FloatCell.put, 2.5)
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(ic)["value"] == 41
+    assert rt.state_of(fc)["value"] == 2.5
+
+
+def test_multi_param_reification():
+    PIF = Pair[I32, F32]
+    rt = Runtime(OPTS)
+    rt.declare(PIF, 1).start()
+    p = rt.spawn(PIF)
+    rt.send(p, PIF.set_both, 7, 1.5)
+    assert rt.run(max_steps=16) == 0
+    st = rt.state_of(p)
+    assert st["a"] == 7 and st["b"] == 1.5
+
+
+def test_ref_of_reified_type_is_wiring_checked():
+    """Ref[Cell[I32]] participates in the sendability checker like any
+    concrete type: sending the wrong reification's behaviour fails the
+    build."""
+    IntCell, FloatCell = Cell[I32], Cell[F32]
+
+    @actor
+    class User:
+        out: Ref[Cell[I32]]
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st, v: I32):
+            self.send(st["out"], FloatCell.put, 1.0)   # wrong reif.
+            return st
+
+    rt = Runtime(OPTS)
+    rt.declare(User, 1).declare(IntCell, 1).declare(FloatCell, 1).start()
+    u = rt.spawn(User)
+    rt.send(u, User.go, 0)
+    with pytest.raises(TypeError, match="sendability"):
+        rt.run(max_steps=4)
+
+
+def test_generic_over_ref_target():
+    """Ref[T]: the parameter is an ACTOR type — a generic forwarder
+    reified per target type (the actor-typed half of reify.c)."""
+    R = TypeParam("R")
+
+    @actor
+    class Sink1:
+        got: I32
+
+        @behaviour
+        def hit(self, st, v: I32):
+            return {**st, "got": st["got"] + v}
+
+    @actor
+    class Fwd:
+        out: Ref[R]
+        MAX_SENDS = 1
+
+        @behaviour
+        def fwd(self, st, v: I32):
+            self.send(st["out"], Sink1.hit, v)
+            return st
+
+    FS = Fwd[Sink1]
+    assert FS.field_specs["out"].target_name == "Sink1"
+    rt = Runtime(OPTS)
+    rt.declare(FS, 1).declare(Sink1, 1).start()
+    s = rt.spawn(Sink1)
+    f = rt.spawn(FS, out=int(s))
+    rt.send(f, FS.fwd, 9)
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(s)["got"] == 9
+
+
+def test_partial_application_stays_generic():
+    """Cell[U] with U itself a TypeParam is still generic: it must
+    refuse declare() exactly like the template (review finding)."""
+    U = TypeParam("U")
+    CU = Cell[U]
+    assert CU._type_params == (U,)
+    rt = Runtime(OPTS)
+    with pytest.raises(TypeError, match="generic over"):
+        rt.declare(CU, 1)
+    # and completing the application works
+    CI = CU[I32]
+    assert CI.field_specs["value"].__name__ == "I32"
+
+
+def test_same_name_type_args_do_not_collide():
+    """Two distinct actor classes sharing a __name__ must reify to
+    DISTINCT types (cache keys by class object, review finding)."""
+    R = TypeParam("R")
+
+    @actor
+    class Box:
+        out: Ref[R]
+
+        @behaviour
+        def poke(self, st, v: I32):
+            return st
+
+    def make_worker(tag):
+        @actor
+        class Worker:
+            x: I32
+
+            @behaviour
+            def go(self, st, v: I32):
+                return {**st, "x": v + tag}
+        return Worker
+
+    W1, W2 = make_worker(1), make_worker(2)
+    assert W1.__name__ == W2.__name__ == "Worker"
+    B1, B2 = Box[W1], Box[W2]
+    assert B1 is not B2
+    assert B1.field_specs["out"].target is W1
+    assert B2.field_specs["out"].target is W2
+
+
+def test_spawn_state_defaults_per_reification():
+    IntCell = Cell[I32]
+    rt = Runtime(OPTS)
+    rt.declare(IntCell, 3).start()
+    ids = rt.spawn_many(IntCell, 3, value=np.asarray([1, 2, 3]))
+    assert rt.run(max_steps=4) == 0
+    st = rt.cohort_state(IntCell)
+    assert list(st["value"][:3]) == [1, 2, 3]
