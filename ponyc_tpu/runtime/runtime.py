@@ -650,6 +650,14 @@ class Runtime:
             self.net = Net(self)
         return self.net
 
+    def attach_resolver(self):
+        """Create (once) the async DNS resolver (≙ the addrinfo surface
+        of lang/socket.c, delivered as actor messages)."""
+        if getattr(self, "resolver", None) is None:
+            from ..net.dns import Resolver
+            self.resolver = Resolver(self)
+        return self.resolver
+
     def attach_processes(self):
         """Create (once) the child-process monitor (≙ packages/process
         over lang/process.c)."""

@@ -1,0 +1,142 @@
+"""Name resolution (net/dns.py ≙ socket.c's addrinfo/nameinfo/host_ip
+surface + packages/net/dns.pony) — loopback-only, no egress."""
+
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.net.dns import DNS
+
+
+def test_literal_detection():
+    assert DNS.is_ip4("127.0.0.1")
+    assert not DNS.is_ip4("::1")
+    assert not DNS.is_ip4("localhost")
+    assert DNS.is_ip6("::1")
+    assert not DNS.is_ip6("127.0.0.1")
+
+
+def test_resolve_loopback():
+    addrs = DNS.resolve("127.0.0.1", 80)
+    assert (4, "127.0.0.1", 80) in addrs
+    assert DNS.ip4("127.0.0.1", 5) == [(4, "127.0.0.1", 5)]
+    v6 = DNS.ip6("::1", 7)
+    assert all(f == 6 for f, _ip, _p in v6)
+    assert DNS.resolve("definitely-not-a-host.invalid.") == []
+
+
+def test_nameinfo_roundtrip():
+    ni = DNS.nameinfo("127.0.0.1", 80)
+    assert ni is not None and len(ni) == 2
+    assert DNS.nameinfo("256.256.256.256") is None
+
+
+def test_async_resolver_delivers_actor_message():
+    got = []
+
+    @actor
+    class Wants:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def on_resolved(self, st, token: I32, h: I32, n: I32):
+            got.append((int(token), self.rt.heap.unbox(int(h)), int(n)))
+            self.rt.request_exit(0)
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=0,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Wants, 1).start()
+    w = rt.spawn(Wants)
+    res = rt.attach_resolver()
+    res.resolve("127.0.0.1", 443, w, on_resolved=Wants.on_resolved,
+                token=9)
+    rt.run(max_steps=200_000)
+    assert len(got) == 1
+    token, addrs, n = got[0]
+    assert token == 9 and n == len(addrs) >= 1
+    assert (4, "127.0.0.1", 443) in addrs
+
+
+def test_async_resolver_failure_is_empty_list():
+    got = []
+
+    @actor
+    class Wants2:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def on_resolved(self, st, token: I32, h: I32, n: I32):
+            got.append((self.rt.heap.unbox(int(h)), int(n)))
+            self.rt.request_exit(0)
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=0,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Wants2, 1).start()
+    w = rt.spawn(Wants2)
+    rt.attach_resolver().resolve("no-such-host.invalid.", 1, w,
+                                 on_resolved=Wants2.on_resolved)
+    rt.run(max_steps=200_000)
+    assert len(got) == 1
+    addrs, n = got[0]
+    assert addrs == [] and n < 0, (addrs, n)   # negative resolver error
+
+
+def test_async_resolver_survives_hostile_hostname():
+    """An overlong IDNA label raises UnicodeError inside getaddrinfo;
+    the lookup must still deliver (n=-1) and release the noisy hold so
+    the world quiesces (review finding)."""
+    got = []
+
+    @actor
+    class Wants3:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def on_resolved(self, st, token: I32, h: I32, n: I32):
+            got.append(int(n))
+            self.rt.heap.drop(int(h))
+            self.rt.request_exit(0)
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=0,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Wants3, 1).start()
+    w = rt.spawn(Wants3)
+    rt.attach_resolver().resolve("a" * 300 + ".com", 1, w,
+                                 on_resolved=Wants3.on_resolved)
+    rt.run(max_steps=200_000)
+    assert got and got[0] < 0
+
+
+def test_async_resolver_validates_owner_eagerly():
+    import pytest
+
+    @actor
+    class Wants4:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def on_resolved(self, st, token: I32, h: I32, n: I32):
+            return st
+
+    @actor
+    class Other4:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def noop(self, st, a: I32, b: I32, c: I32):
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=0,
+                                msg_words=3, inject_slots=8))
+    rt.declare(Wants4, 1).declare(Other4, 1).start()
+    rt.spawn(Wants4)
+    o = rt.spawn(Other4)
+    # wrong-cohort owner fails AT THE CALL SITE, not inside a later poll
+    with pytest.raises(TypeError, match="sendability"):
+        rt.attach_resolver().resolve("127.0.0.1", 1, int(o),
+                                     on_resolved=Wants4.on_resolved)
