@@ -68,4 +68,43 @@ assert rc2 == 0, rc2
 done2 = rt2.counter("n_processed")
 assert done2 == ring_hops, (done2, ring_hops)
 print(f"RANK{rank}_RING_OK hops={done2}", flush=True)
+
+# --- 3. pressure fan-in across the boundary: every shard's producers
+# flood one aggregator on shard 0 through a tiny route bucket, so the
+# route-spill → mute → retry → unmute machinery itself crosses
+# processes (the dryrun_multichip pressure scenario, but with the muted
+# senders spread over BOTH OS processes). Reuses the shared fan-in
+# model (ponyc_tpu/models/fanin.py) — one protocol definition for the
+# bench, the dryrun, and this worker.
+from ponyc_tpu import Runtime                       # noqa: E402
+from ponyc_tpu.models.fanin import (Aggregator,     # noqa: E402
+                                    Producer)
+
+n_src, items = 6 * shards, 4
+opts3 = RuntimeOptions(mailbox_cap=4, batch=1, max_sends=2, msg_words=2,
+                       mesh_shards=shards, spill_cap=4096,
+                       inject_slots=64, quiesce_interval=1,
+                       route_bucket=8)
+rt3 = Runtime(opts3)
+rt3.declare(Producer, n_src).declare(Aggregator, 4)
+rt3.start()
+agg = rt3.spawn(Aggregator)
+srcs = rt3.spawn_many(Producer, n_src, out=int(agg))
+rt3.bulk_send(srcs, Producer.produce, np.full(n_src, items, np.int64))
+saw_rspill = saw_muted = False
+got = 0
+for _ in range(75 * shards):
+    rt3.run(max_steps=1)
+    saw_rspill = saw_rspill or rt3.counter("rspill_count") > 0
+    saw_muted = saw_muted or bool(rt3._fetch(rt3.state.muted).any())
+    got = rt3.state_of(int(agg))["total"]
+    if got == n_src * items:
+        break
+assert got == n_src * items, (got, n_src * items)
+assert saw_rspill, "route spill never engaged across processes"
+assert saw_muted, "pressure never muted a sender across processes"
+rt3.run(max_steps=80)
+assert not bool(rt3._fetch(rt3.state.muted).any())
+assert rt3.counter("rspill_count") == 0
+print(f"RANK{rank}_PRESSURE_OK got={got}", flush=True)
 print(f"RANK{rank}_ALL_OK", flush=True)

@@ -72,10 +72,11 @@ def test_engine_across_two_processes():
         for r in range(2)]
     try:
         for rank, p in enumerate(procs):
-            out, _ = p.communicate(timeout=360)
+            out, _ = p.communicate(timeout=420)
             assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
             assert f"RANK{rank}_UBENCH_OK" in out
             assert f"RANK{rank}_RING_OK" in out
+            assert f"RANK{rank}_PRESSURE_OK" in out
             assert f"RANK{rank}_ALL_OK" in out
     finally:
         for p in procs:
