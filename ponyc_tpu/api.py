@@ -253,7 +253,8 @@ class Context:
                  "yield_flag", "destroy_flag", "spawn_fail", "_spawn_resv",
                  "spawn_claims", "destroy_called", "error_flag",
                  "error_code", "error_loc", "error_called", "ref_types",
-                 "_spawn_meta", "sync_inits", "_effected", "cap_moves")
+                 "_spawn_meta", "sync_inits", "_effected", "cap_moves",
+                 "cap_types")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None,
                  spawn_meta=None):
@@ -281,6 +282,9 @@ class Context:
         self.ref_types = pack.RefTypes()
         # Trace-time iso-move discipline (≙ type/alias.c consume rules).
         self.cap_moves = pack.CapMoves()
+        # Capability provenance of traced values (≙ the cap half of the
+        # type checker; engine tags declared Iso/Val/Tag fields + args).
+        self.cap_types = pack.CapTypes()
         # {target type name: field_specs} for sync construction.
         self._spawn_meta = spawn_meta or {}
         # {target type name: {site index: (state dict, ok mask)}}.
@@ -317,7 +321,9 @@ class Context:
                     f"Ref[{want}] but was passed a Ref[{got}]")
         # Iso move discipline (≙ cap.c/alias.c/safeto.c consume rules):
         # a moved handle may never be used again this dispatch, and an
-        # Iso-parameter send IS a move.
+        # Iso-parameter send IS a move. Capability provenance must also
+        # cover the parameter's declared mode (≙ is_cap_sub_cap: a
+        # shared val cannot be passed where a unique iso is required).
         where = f"{owner}.{behaviour_def.name} send"
         for spec, a in zip(behaviour_def.arg_specs, args):
             if pack.concrete_null_handle(a):
@@ -327,9 +333,25 @@ class Context:
                 raise TypeError(
                     f"capability: use-after-move — payload already moved "
                     f"by {prev} is passed to {where}")
+            src = self.cap_types.lookup(a)
+            want = pack.cap_mode(spec)
+            if not pack.cap_store_ok(src, want):
+                raise TypeError(
+                    f"capability: {where} declares its parameter "
+                    f"{want.capitalize()} but was passed a {src} "
+                    f"payload — a {src} value cannot grant the rights "
+                    f"{want} requires (is_cap_sub_cap, type/cap.c)")
         for spec, a in zip(behaviour_def.arg_specs, args):
-            if (pack.cap_mode(spec) == "iso"
-                    and not pack.concrete_null_handle(a)):
+            if pack.concrete_null_handle(a):
+                continue
+            want = pack.cap_mode(spec)
+            # The payload SHIPS whenever it rides a capability-typed
+            # parameter; if the sender's value is unique (iso — by
+            # declared parameter mode or by provenance), shipping it is
+            # a MOVE, including the legal iso→val/tag downgrades. The
+            # sender provably loses it either way.
+            if want == "iso" or (want is not None
+                                 and self.cap_types.lookup(a) == "iso"):
                 self.cap_moves.move(a, where)
         payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
         # Planar-aware: payload is [W] (all-constant args) or [W, R]
@@ -406,8 +428,11 @@ class Context:
             raise RuntimeError(
                 "spawn_sync is only available in device behaviours")
         used = len(self.spawn_claims[tname]) - 1   # site just claimed
-        # Constructor arguments obey the same sendability rule as a send
-        # (≙ expr/call.c parameter checks): a typed ref arg must match.
+        # Constructor arguments obey the same sendability + capability
+        # rules as a send (≙ expr/call.c parameter checks): a typed ref
+        # arg must match, a cap-typed arg must satisfy the store
+        # lattice, and handing a unique to the newborn is a MOVE.
+        where = f"{tname}.{ctor.name} spawn_sync"
         for spec, a in zip(ctor.arg_specs, args):
             want = pack.ref_target(spec)
             got = self.ref_types.lookup(a)
@@ -415,6 +440,28 @@ class Context:
                 raise TypeError(
                     f"sendability: {tname}.{ctor.name} expects Ref[{want}] "
                     f"but was passed a Ref[{got}]")
+            if pack.concrete_null_handle(a):
+                continue
+            prev = self.cap_moves.was_moved(a)
+            if prev is not None:
+                raise TypeError(
+                    f"capability: use-after-move — payload already moved "
+                    f"by {prev} is passed to {where}")
+            cwant = pack.cap_mode(spec)
+            src = self.cap_types.lookup(a)
+            if not pack.cap_store_ok(src, cwant):
+                raise TypeError(
+                    f"capability: {where} declares its parameter "
+                    f"{cwant.capitalize()} but was passed a {src} "
+                    f"payload — a {src} value cannot grant the rights "
+                    f"{cwant} requires (is_cap_sub_cap, type/cap.c)")
+        for spec, a in zip(ctor.arg_specs, args):
+            if pack.concrete_null_handle(a):
+                continue
+            cwant = pack.cap_mode(spec)
+            if cwant == "iso" or (cwant is not None
+                                  and self.cap_types.lookup(a) == "iso"):
+                self.cap_moves.move(a, where)
         # Run the constructor NOW on zeroed defaults (≙ the synchronous
         # field assignment), in a throwaway context that must stay inert.
         cctx = Context(ref, self.msg_words)
@@ -438,6 +485,19 @@ class Context:
                 raise TypeError(
                     f"sendability: sync constructor {ctor} stores a "
                     f"Ref[{got}] into field {f!r} declared Ref[{want}]")
+            # Cap lattice applies to the newborn's fields too (the
+            # OUTER provenance map: values flow from the spawner's
+            # args/fields through the constructor).
+            if pack.concrete_null_handle(st2[f]):
+                continue
+            src = self.cap_types.lookup(st2[f])
+            dst = pack.cap_mode(s)
+            if not pack.cap_store_ok(src, dst):
+                raise TypeError(
+                    f"capability: sync constructor {ctor} stores a "
+                    f"{src} payload into field {f!r} declared "
+                    f"{dst.capitalize()} — a {src} value cannot grant "
+                    f"the rights {dst} requires (is_cap_sub_cap)")
         self.sync_inits.setdefault(tname, {})[used] = (st2, ok)
         return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
 

@@ -15,7 +15,11 @@ safeto.c:1 — the qualifiers that make a payload sendable):
   access, so no GC protocol is needed at all). Sending it through an
   ``Iso``-annotated parameter marks it in-flight: peek/unbox before the
   receiver takes delivery is use-after-send, and a second send is an
-  aliased move — both raise.
+  aliased move — both raise. Delivery to a HOST actor completes the
+  move (receive()); a handle sent into the DEVICE world stays
+  in-flight for the host until some device actor forwards it back to a
+  host receiver — the host gave it away, which is exactly the
+  discipline.
 - ``val`` (``box_val``): shared-immutable. Anyone may `peek`; `unbox`
   (taking ownership) is rejected; aliasing is free. Collected by the
   tracing GC when unreachable.

@@ -250,6 +250,26 @@ def concrete_null_handle(a) -> bool:
         return False
 
 
+# The store lattice (≙ is_cap_sub_cap, type/cap.c — the sendable
+# fragment): a value of mode SRC may be stored where DST is declared
+# when SRC's rights cover DST's. iso (unique, all rights) may be
+# downgraded to anything — THAT STORE IS A MOVE. val (shared read)
+# may stay val or drop to tag. tag (address only) stays tag.
+_CAP_STORE_OK = {
+    ("iso", "iso"): True, ("iso", "val"): True, ("iso", "tag"): True,
+    ("val", "iso"): False, ("val", "val"): True, ("val", "tag"): True,
+    ("tag", "iso"): False, ("tag", "val"): False, ("tag", "tag"): True,
+}
+
+
+def cap_store_ok(src_mode, dst_mode) -> bool:
+    """May a value of src_mode be stored into a dst_mode slot?
+    Unknown provenance (None) is gradual — allowed."""
+    if src_mode is None or dst_mode is None:
+        return True
+    return _CAP_STORE_OK[(src_mode, dst_mode)]
+
+
 class CapMoves:
     """Trace-time iso-move discipline (≙ the consume/alias analysis of
     type/alias.c + safeto.c, re-expressed at the trace boundary).
@@ -358,6 +378,14 @@ class RefTypes:
     def lookup(self, obj):
         ent = self._m.get(id(obj))
         return ent[1] if ent is not None else None
+
+
+class CapTypes(RefTypes):
+    """Capability provenance map — the cap half of RefTypes, same
+    identity-keyed mechanics (tag/lookup over id with strong pinning):
+    values that arrived through an Iso/Val/Tag-annotated parameter or
+    field carry their mode, so stores and parameter passes check
+    against the declared mode (cap_store_ok)."""
 
 
 _MARKERS = (I32, F32, Bool, Ref, U32, I16, U16, I8, U8)

@@ -123,8 +123,10 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
     # build) without touching how refs behave under jnp ops.
     for k, v in st.items():
         ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
+        ctx.cap_types.tag(v, pack.cap_mode(field_specs[k]))
     for spec, a in zip(bdef.arg_specs, args):
         ctx.ref_types.tag(a, pack.ref_target(spec))
+        ctx.cap_types.tag(a, pack.cap_mode(spec))
     st2 = bdef.fn(ctx, dict(st), *args)
     if st2 is None:
         raise TypeError(
@@ -153,6 +155,33 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
                 f"payload in field {k!r} (moved by {moved}); an iso is "
                 "moved-unique — clear the field (e.g. -1) or use Val "
                 "for shared-immutable payloads")
+        # Store lattice (≙ is_cap_sub_cap): the stored value's
+        # capability provenance must cover the field's declared mode
+        # (a shared val cannot become a unique iso; a tag cannot
+        # become readable).
+        src = (None if pack.concrete_null_handle(v)
+               else ctx.cap_types.lookup(v))
+        dst = pack.cap_mode(field_specs[k])
+        if not pack.cap_store_ok(src, dst):
+            raise TypeError(
+                f"capability: behaviour {bdef} stores a {src} payload "
+                f"into field {k!r} declared {dst.capitalize()} — a "
+                f"{src} value cannot grant the rights {dst} requires "
+                "(is_cap_sub_cap, type/cap.c)")
+    # An iso-provenance value stored into MORE THAN ONE field aliases a
+    # unique (≙ alias.c): every field keeping it is a distinct owner.
+    iso_seen = {}
+    for k, v in st2.items():
+        if pack.concrete_null_handle(v):
+            continue
+        if ctx.cap_types.lookup(v) == "iso":
+            first = iso_seen.get(id(v))
+            if first is not None:
+                raise TypeError(
+                    f"capability: behaviour {bdef} stores one iso "
+                    f"payload into BOTH fields {first!r} and {k!r} — "
+                    "an iso has exactly one owner (alias.c)")
+            iso_seen[id(v)] = k
     st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
            for k, v in st2.items()}
     if len(ctx.sends) > max_sends:

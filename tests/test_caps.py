@@ -182,6 +182,190 @@ def test_val_aliases_freely():
     assert rt.state_of(int(r2))["seen"] == 1
 
 
+def test_val_cannot_be_passed_as_iso_parameter():
+    """The store lattice (≙ is_cap_sub_cap): a shared val cannot grant
+    the unique ownership an Iso parameter requires."""
+
+    @actor
+    class BadUpgrade:
+        out: Ref["Holder"]
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st, h: Val):
+            self.send(st["out"], Holder.take, h)   # Val -> Iso param!
+            return st
+
+    rt = Runtime(OPTS)
+    rt.declare(BadUpgrade, 1).declare(Holder, 1).start()
+    b = rt.spawn(BadUpgrade)
+    rt.send(b, BadUpgrade.go, 7)
+    with pytest.raises(TypeError, match="cannot grant"):
+        rt.run(max_steps=4)
+
+
+def test_val_cannot_be_stored_into_iso_field():
+    @actor
+    class BadStore:
+        stash: Iso
+
+        @behaviour
+        def keep(self, st, h: Val):
+            return {**st, "stash": h}              # Val -> Iso field!
+
+    rt = Runtime(OPTS)
+    rt.declare(BadStore, 1).start()
+    b = rt.spawn(BadStore)
+    rt.send(b, BadStore.keep, 7)
+    with pytest.raises(TypeError, match="cannot grant"):
+        rt.run(max_steps=4)
+
+
+def test_iso_downgrades_to_val_field():
+    """iso → val is the legal downgrade (unique consumed into shared),
+    and tag accepts anything readable it came from... iso→tag too."""
+
+    @actor
+    class Downgrade:
+        shared: Val
+        opaque: Tag
+
+        @behaviour
+        def keep(self, st, h: Iso, t: Iso):
+            return {**st, "shared": h, "opaque": t}
+
+    rt = Runtime(OPTS)
+    rt.declare(Downgrade, 1).start()
+    d = rt.spawn(Downgrade)
+    rt.send(d, Downgrade.keep, 5, 6)
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(d)["shared"] == 5
+    assert rt.state_of(d)["opaque"] == 6
+
+
+def test_tag_cannot_become_readable():
+    @actor
+    class BadRead:
+        shared: Val
+
+        @behaviour
+        def keep(self, st, t: Tag):
+            return {**st, "shared": t}             # Tag -> Val field!
+
+    rt = Runtime(OPTS)
+    rt.declare(BadRead, 1).start()
+    b = rt.spawn(BadRead)
+    rt.send(b, BadRead.keep, 7)
+    with pytest.raises(TypeError, match="cannot grant"):
+        rt.run(max_steps=4)
+
+
+def test_iso_stored_into_two_fields_is_aliasing():
+    @actor
+    class TwoOwners:
+        a: Iso
+        b: Iso
+
+        @behaviour
+        def keep(self, st, h: Iso):
+            return {**st, "a": h, "b": h}          # two owners!
+
+    rt = Runtime(OPTS)
+    rt.declare(TwoOwners, 1).start()
+    t = rt.spawn(TwoOwners)
+    rt.send(t, TwoOwners.keep, 7)
+    with pytest.raises(TypeError, match="exactly one owner"):
+        rt.run(max_steps=4)
+
+
+def test_iso_downgrade_send_is_a_move():
+    """Shipping an iso through a Val parameter is still a MOVE: the
+    sender cannot also retain it (review finding — two owners across
+    actors otherwise)."""
+
+    @actor
+    class BadShare:
+        log: Ref["Reader"]
+        stash: Iso
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st, h: Iso):
+            self.send(st["log"], Reader.look, h)   # iso -> Val param
+            return {**st, "stash": h}              # ...and retains it!
+
+    rt = Runtime(OPTS)
+    rt.declare(BadShare, 1).declare(Reader, 1).start()
+    b = rt.spawn(BadShare)
+    rt.send(b, BadShare.go, 7)
+    with pytest.raises(TypeError, match="retains a moved iso"):
+        rt.run(max_steps=4)
+
+
+def test_spawn_sync_obeys_cap_lattice():
+    """The sync-constructor path enforces the same lattice (review
+    finding): a Val payload cannot initialise an Iso field through
+    spawn_sync."""
+
+    @actor
+    class Kid:
+        stash: Iso
+
+        @behaviour
+        def create(self, st, h: Iso):
+            return {**st, "stash": h}
+
+    @actor
+    class BadParent:
+        MAX_SENDS = 1
+        SPAWNS = {"Kid": 1}
+
+        @behaviour
+        def make(self, st, h: Val):
+            self.spawn_sync(Kid.create, h)         # Val -> Iso ctor arg
+            return st
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=2, inject_slots=8))
+    rt.declare(BadParent, 1).declare(Kid, 2).start()
+    p = rt.spawn(BadParent)
+    rt.send(p, BadParent.make, 7)
+    with pytest.raises(TypeError, match="cannot grant"):
+        rt.run(max_steps=4)
+
+
+def test_spawn_sync_iso_arg_moves_to_newborn():
+    """Handing an iso to a sync constructor moves it: the spawner
+    cannot retain it afterwards."""
+
+    @actor
+    class Kid2:
+        stash: Iso
+
+        @behaviour
+        def create(self, st, h: Iso):
+            return {**st, "stash": h}
+
+    @actor
+    class BadKeeper:
+        mine: Iso
+        MAX_SENDS = 1
+        SPAWNS = {"Kid2": 1}
+
+        @behaviour
+        def make(self, st, h: Iso):
+            self.spawn_sync(Kid2.create, h)
+            return {**st, "mine": h}               # retained after move
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                                msg_words=2, inject_slots=8))
+    rt.declare(BadKeeper, 1).declare(Kid2, 2).start()
+    k = rt.spawn(BadKeeper)
+    rt.send(k, BadKeeper.make, 7)
+    with pytest.raises(TypeError, match="retains a moved iso"):
+        rt.run(max_steps=4)
+
+
 # ---------------- dynamic (host heap) discipline ----------------
 
 def test_heap_iso_unbox_consumes_and_double_take_raises():
