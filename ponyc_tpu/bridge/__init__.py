@@ -41,6 +41,19 @@ class Bridge:
         self._subs: Dict[int, BehaviourDef] = {}
         self._cbs: Dict[int, object] = {}   # internal callback subscribers
         self._noisy_given = 0     # noisy holds mirrored into the runtime
+        try:
+            if rt.opts.pin_asio >= 0:  # ≙ --ponypinasio (start.c:75-94)
+                self.loop.pin(rt.opts.pin_asio)
+            elif rt.opts.pin >= 0:
+                # The driver thread is pinned but the I/O thread was
+                # asked to stay free: new threads INHERIT the creator's
+                # mask, so restore the pre-pin mask explicitly.
+                mask = getattr(rt, "_pre_pin_affinity", None)
+                if mask:
+                    self.loop.set_affinity(sorted(mask))
+        except OSError:
+            self.loop.close()      # don't leak the epoll thread + fds
+            raise
 
     # -- subscriptions (≙ pony_asio_event_create/subscribe) --
     def _check(self, owner: int, bdef: BehaviourDef) -> None:

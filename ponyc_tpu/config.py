@@ -90,6 +90,11 @@ class RuntimeOptions:
     # --- host bridge (≙ asio/) ---
     inject_slots: int = 256        # host→device injected msgs per step
     host_out_slots: int = 256      # device→host delivered msgs per step
+    pin: int = -1                  # ≙ --ponypin: pin the host driver
+    #   thread to this core (-1 = unpinned); the TPU analog of pinning
+    #   scheduler threads — keeps the dispatch loop off noisy cores
+    pin_asio: int = -1             # ≙ --ponypinasio: pin the native
+    #   event-loop thread to this core (-1 = unpinned)
 
     # --- analysis / telemetry (≙ --ponyanalysis, analysis.c) ---
     analysis: int = 0              # 0 off, 1 summary, 2 window CSV,

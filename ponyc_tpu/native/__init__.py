@@ -77,6 +77,9 @@ def lib() -> ctypes.CDLL:
 
         l.ponyx_asio_create.restype = c.c_void_p
         l.ponyx_asio_destroy.argtypes = [c.c_void_p]
+        l.ponyx_asio_setaffinity.restype = c.c_int32
+        l.ponyx_asio_setaffinity.argtypes = [
+            c.c_void_p, c.POINTER(c.c_int32), c.c_int32]
         l.ponyx_asio_timer.restype = c.c_int32
         l.ponyx_asio_timer.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
                                        c.c_int32, c.c_int32, c.c_int32,
@@ -481,6 +484,19 @@ class AsioLoop:
     def __init__(self):
         self._l = lib()
         self._h = self._l.ponyx_asio_create()
+
+    def set_affinity(self, cores) -> None:
+        """Set the event-loop thread's core set (≙ --ponypinasio,
+        start.c:75-94 / ponyint_cpu_affinity, cpu.c:278); one core =
+        a pin, the original full mask = an unpin."""
+        cs = [int(x) for x in cores]
+        arr = (ctypes.c_int32 * len(cs))(*cs)
+        r = self._l.ponyx_asio_setaffinity(self._h, arr, len(cs))
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+
+    def pin(self, core: int) -> None:
+        self.set_affinity([core])
 
     def timer(self, first_ns: int, interval_ns: int, owner: int,
               behaviour: int, *, oneshot: bool = False,

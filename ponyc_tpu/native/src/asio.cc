@@ -25,6 +25,8 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <pthread.h>
+#include <sched.h>
 #include <signal.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -224,6 +226,30 @@ ponyx_asio_t* ponyx_asio_create() {
   l->running.store(true, std::memory_order_release);
   l->thread = std::thread(loop_main, l);
   return l;
+}
+
+// Set the event-loop thread's affinity to a core set (≙ --ponypinasio,
+// start.c:75-94 + ponyint_cpu_affinity, sched/cpu.c:278): latency-
+// sensitive deployments keep the I/O thread off the busy cores — or
+// restore the full mask when only the DRIVER thread is pinned (new
+// threads inherit the creator's mask). Returns 0 on success, -errno
+// otherwise (this file's convention).
+int32_t ponyx_asio_setaffinity(ponyx_asio_t* l, const int32_t* cores,
+                               int32_t n) {
+  if (n <= 0 || cores == nullptr) return -EINVAL;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  bool any = false;
+  for (int32_t i = 0; i < n; i++) {
+    if (cores[i] >= 0 && cores[i] < CPU_SETSIZE) {
+      CPU_SET(cores[i], &set);
+      any = true;
+    }
+  }
+  if (!any) return -EINVAL;
+  int err = pthread_setaffinity_np(l->thread.native_handle(),
+                                   sizeof(set), &set);
+  return err ? -err : 0;
 }
 
 void ponyx_asio_destroy(ponyx_asio_t* l) {

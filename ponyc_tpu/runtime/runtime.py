@@ -221,6 +221,15 @@ class Runtime:
                 self.opts = _dc.replace(self.opts, **overrides)
                 self.program.opts = self.opts
                 self.program.shards = max(1, self.opts.mesh_shards)
+        if self.opts.pin >= 0:   # ≙ --ponypin (start.c:75-94): pin the
+            # host driver thread (the "scheduler" of this runtime)
+            try:
+                self._pre_pin_affinity = os.sched_getaffinity(0)
+                os.sched_setaffinity(0, {self.opts.pin})
+            except OSError as e:
+                raise ValueError(
+                    f"cannot pin host thread to core {self.opts.pin}: "
+                    f"{e}") from None
         self.program.finalize()
         self.state = init_state(self.program, self.opts)
         if self.program.shards > 1:

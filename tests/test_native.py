@@ -265,3 +265,46 @@ def test_native_microbench_sane():
                         "mpscq_mt_4prod_4w_ns"}
     for k, v in res.items():
         assert 0.5 < v < 100_000, (k, v)
+
+
+def test_affinity_pinning():
+    """≙ --ponypin / --ponypinasio (start.c:75-94, cpu.c:278): the host
+    driver thread and the native event-loop thread pin to cores."""
+    import os
+
+    from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+    @actor
+    class P:
+        n: I32
+
+        @behaviour
+        def tick(self, st, v: I32):
+            return {**st, "n": st["n"] + v}
+
+    before = os.sched_getaffinity(0)
+    core = min(before)             # a core this cgroup actually allows
+    try:
+        rt = Runtime(RuntimeOptions(msg_words=1, pin=core,
+                                    pin_asio=core))
+        rt.declare(P, 1).start()
+        assert os.sched_getaffinity(0) == {core}
+        b = rt.attach_bridge()           # pins the asio thread (no raise)
+        a = rt.spawn(P)
+        rt.send(a, P.tick, 5)
+        assert rt.run(max_steps=50) == 0
+        assert rt.state_of(a)["n"] == 5
+        b.close()
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_affinity_bad_core_raises():
+    from ponyc_tpu import Runtime, RuntimeOptions
+
+    rt = Runtime(RuntimeOptions(msg_words=1, pin=4096))
+    try:
+        rt.start()
+        raise AssertionError("pin to absurd core did not raise")
+    except ValueError:
+        pass
