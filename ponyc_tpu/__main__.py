@@ -16,6 +16,9 @@ Commands:
   test [pytest args...]       the test suite (≙ ponytest aggregate).
   doc <module[:ATTR]> [-o D]  generate docs for actor types reachable
                               from a module (≙ docgen pass, docgen.c).
+  verify <module>             probe-trace every behaviour's effect
+                              signature; fail on budget violations
+                              (≙ the verify stage, verify/fun.c).
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -133,6 +136,44 @@ def cmd_doc(argv) -> int:
     return 0
 
 
+def cmd_verify(argv) -> int:
+    """Run the verify pass over a module's actor types (≙ the verify
+    stage of the compile pipeline, verify/fun.c): print each
+    behaviour's effect signature, fail on budget violations."""
+    if not argv:
+        print("ponyc_tpu verify: missing module", file=sys.stderr)
+        return 2
+    import importlib
+
+    from .api import ActorTypeMeta
+    from .verify import VerifyError
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(argv[0])
+    atypes = [v for v in vars(mod).values()
+              if isinstance(v, ActorTypeMeta)
+              and not getattr(v, "_type_params", ())]
+    if not atypes:
+        print(f"ponyc_tpu verify: no concrete actor types in {argv[0]}",
+              file=sys.stderr)
+        return 1
+    from .verify import verify_behaviour
+    bad = 0
+    for atype in atypes:
+        for bdef in atype.behaviour_defs:
+            try:
+                eff = verify_behaviour(bdef)
+            except (VerifyError, TypeError, RuntimeError) as e:
+                # Budget violations AND trace-time failures
+                # (sendability/capability errors) report as FAILs, not
+                # tracebacks, and the sweep continues.
+                print(f"FAIL {atype.__name__}.{bdef.name}: {e}")
+                bad += 1
+                continue
+            marks = eff.marks() or "pure state update"
+            print(f"ok   {atype.__name__}.{bdef.name}: {marks}")
+    return 1 if bad else 0
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -147,7 +188,8 @@ def cmd_version(_argv) -> int:
 
 
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
-            "doc": cmd_doc, "version": cmd_version}
+            "doc": cmd_doc, "verify": cmd_verify,
+            "version": cmd_version}
 
 
 def main(argv=None) -> int:

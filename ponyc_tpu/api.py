@@ -254,7 +254,7 @@ class Context:
                  "spawn_claims", "destroy_called", "error_flag",
                  "error_code", "error_loc", "error_called", "ref_types",
                  "_spawn_meta", "sync_inits", "_effected", "cap_moves",
-                 "cap_types")
+                 "cap_types", "exit_called", "yield_called")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None,
                  spawn_meta=None):
@@ -271,6 +271,8 @@ class Context:
         self.error_code = jnp.int32(0)
         self.error_loc = jnp.int32(0)
         self.error_called = False        # trace-time: did error_int() run?
+        self.exit_called = False         # trace-time: did exit() run?
+        self.yield_called = False        # trace-time: did yield_() run?
         # {target type name: [n_sites] i32 reserved global ids} for this
         # dispatch; None entries = -1 (no free slot was available).
         self._spawn_resv = spawn_resv or {}
@@ -517,6 +519,7 @@ class Context:
     def exit(self, code=0, when=True):
         """Request program termination (≙ pony_exitcode + quiescent stop)."""
         self._effected = True
+        self.exit_called = True
         w = jnp.asarray(when, jnp.bool_)
         self.exit_flag = self.exit_flag | w
         self.exit_code = jnp.where(w, jnp.asarray(code, jnp.int32),
@@ -526,6 +529,7 @@ class Context:
         """Stop draining this actor's mailbox for the rest of the step
         (≙ the fork's ponyint_actor_yield, actor.c:675-679)."""
         self._effected = True
+        self.yield_called = True
         self.yield_flag = self.yield_flag | jnp.asarray(when, jnp.bool_)
 
     def error_int(self, code, when=True):

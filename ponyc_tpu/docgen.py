@@ -60,8 +60,18 @@ def document_type(atype: ActorTypeMeta) -> str:
         lines.append("")
     for bdef in atype.behaviour_defs:
         lines.append(f"### be {_sig(bdef)}")
-        bdoc = inspect.getdoc(bdef.fn)
         lines.append("")
+        # Effect marks (the verify pass, ≙ Pony's `?` partial mark in
+        # generated docs): discovered by probe tracing; generic
+        # templates and trace failures degrade to no marks.
+        try:
+            from .verify import behaviour_effects
+            marks = behaviour_effects(bdef, atype).marks()
+            if marks:
+                lines += [f"*effects: {marks}*", ""]
+        except Exception:                    # noqa: BLE001 — doc only
+            pass
+        bdoc = inspect.getdoc(bdef.fn)
         if bdoc:
             lines += [bdoc, ""]
     return "\n".join(lines)

@@ -1,0 +1,195 @@
+"""Verify pass: per-behaviour effect signatures, discovered by probe
+tracing.
+
+≙ the reference's verify stage (src/libponyc/verify/fun.c: after type
+checking, every function's partial-call/error behaviour is analysed and
+mismatches rejected). Errors here are VALUES (ctx.error_int — the
+fork's pony_error_int), so there is no caller-must-handle obligation to
+enforce; what the pass delivers instead is the same ANALYSIS made
+queryable: which behaviours can error/destroy/exit/yield, how many
+sends they perform against the type's budget, and what they spawn —
+surfaced programmatically (`verify_program`), in generated docs
+(docgen marks behaviours like Pony marks partial functions with `?`),
+and as hard failures for budget violations at verify time instead of
+first dispatch.
+
+Probe tracing uses jax.eval_shape (abstract values, no compilation), so
+verifying a program costs milliseconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .api import ActorTypeMeta, BehaviourDef, Context
+from .ops import pack
+
+
+@dataclasses.dataclass(frozen=True)
+class Effects:
+    """What one behaviour DOES, beyond its state update."""
+
+    sends: int                    # ctx.send call sites
+    max_sends: int                # the type's declared budget
+    can_error: bool               # ctx.error_int reachable
+    can_destroy: bool             # ctx.destroy reachable
+    can_exit: bool                # ctx.exit reachable
+    can_yield: bool               # ctx.yield_ reachable
+    spawns: Tuple[Tuple[str, int], ...]   # (target type, claim sites)
+    sync_spawns: Tuple[str, ...]  # targets constructed synchronously
+
+    def marks(self) -> str:
+        """Compact docgen suffix (≙ Pony's `?` partial mark)."""
+        out = []
+        if self.sends:
+            out.append(f"sends≤{self.sends}")
+        for t, n in self.spawns:
+            out.append(f"spawns {t}×{n}")
+        if self.sync_spawns:
+            out.append("sync-constructs "
+                       + ",".join(sorted(set(self.sync_spawns))))
+        if self.can_error:
+            out.append("may error")      # ≙ the `?` mark
+        if self.can_destroy:
+            out.append("may destroy")
+        if self.can_exit:
+            out.append("may exit")
+        if self.can_yield:
+            out.append("may yield")
+        return ", ".join(out)
+
+
+class VerifyError(TypeError):
+    """A behaviour violates its type's declared budgets (≙ the verify
+    pass rejecting a method body, verify/fun.c)."""
+
+
+class _ProbeContext(Context):
+    """A Context usable BEFORE any Program exists: send() counts the
+    call and keeps the when-mask effect, without requiring registered
+    behaviour ids or packing against a concrete msg_words (the verify
+    pass runs on bare actor classes, like the reference verifying a
+    method body before reachability)."""
+
+    def send(self, target, behaviour_def, *args, when=True):
+        if not isinstance(behaviour_def, BehaviourDef):
+            raise TypeError(
+                "second argument to send() must be a behaviour "
+                "(e.g. SomeActor.some_behaviour)")
+        self.sends.append((target, None, when))
+
+    def spawn_sync(self, ctor, *args, when=True):
+        """Claim-only: the ctor does not RUN during effect probing (it
+        must be pure construction anyway — the real path enforces
+        that), so string-form SPAWNS targets need no field specs."""
+        tname, ref, ok = self._claim_slot(ctor, when, "spawn_sync")
+        self.sync_inits.setdefault(tname, {})
+        return self.ref_types.tag(ref, tname)
+
+
+def behaviour_effects(bdef: BehaviourDef,
+                      atype: Optional[ActorTypeMeta] = None,
+                      msg_words: int = 8,
+                      default_max_sends: int = 2) -> Effects:
+    """Probe-trace one behaviour on abstract 1-lane values and collect
+    its effect signature. Host behaviours (HOST=True types) run real
+    Python — they are not traced and report zero device effects.
+
+    `default_max_sends` is the RuntimeOptions.max_sends fallback; the
+    budget resolves EXACTLY as program build does
+    (`MAX_SENDS or opts.max_sends`, program.py) so verify enforces the
+    budget the engine actually uses."""
+    atype = atype or bdef.actor_type
+    field_specs = atype.field_specs
+    max_sends = (getattr(atype, "MAX_SENDS", None)
+                 or int(default_max_sends))
+    if getattr(atype, "HOST", False):
+        return Effects(0, 0, False, False, False, False, (), ())
+    spawn_budget = {
+        (t if isinstance(t, str) else t.__name__): n
+        for t, n in getattr(atype, "SPAWNS", {}).items()}
+    box: Dict[str, Context] = {}
+
+    def probe(st, args):
+        resv = {t: jnp.full((max(1, n),), -1, jnp.int32)
+                for t, n in spawn_budget.items()}
+        ctx = _ProbeContext(jnp.int32(0), msg_words, spawn_resv=resv,
+                            spawn_meta={t: {} for t in spawn_budget})
+        for k, v in st.items():
+            ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
+            ctx.cap_types.tag(v, pack.cap_mode(field_specs[k]))
+        for spec, a in zip(bdef.arg_specs, args):
+            ctx.ref_types.tag(a, pack.ref_target(spec))
+            ctx.cap_types.tag(a, pack.cap_mode(spec))
+        box["ctx"] = ctx
+        st2 = bdef.fn(ctx, dict(st), *args)
+        return st2
+
+    st = {k: jnp.zeros((), jnp.float32 if s is pack.F32 else jnp.int32)
+          for k, s in field_specs.items()}
+    args = []
+    for spec in bdef.arg_specs:
+        if isinstance(spec, pack._VecSpec):
+            dt = jnp.float32 if spec.base is pack.F32 else jnp.int32
+            args.append(jnp.zeros((spec.n,), dt))
+        elif spec is pack.F32:
+            args.append(jnp.zeros((), jnp.float32))
+        elif spec is pack.Bool:
+            args.append(jnp.zeros((), jnp.bool_))
+        elif spec in pack._NARROW_JNP:
+            args.append(jnp.zeros((), pack._NARROW_JNP[spec]))
+        else:
+            args.append(jnp.zeros((), jnp.int32))
+    jax.eval_shape(probe, st, tuple(args))
+    ctx = box["ctx"]
+    return Effects(
+        sends=len(ctx.sends),
+        max_sends=int(max_sends),
+        can_error=ctx.error_called,
+        can_destroy=ctx.destroy_called,
+        can_exit=ctx.exit_called,
+        can_yield=ctx.yield_called,
+        spawns=tuple(sorted((t, len(c))
+                            for t, c in ctx.spawn_claims.items() if c)),
+        sync_spawns=tuple(sorted(ctx.sync_inits.keys())),
+    )
+
+
+def verify_behaviour(bdef: BehaviourDef,
+                     default_max_sends: int = 2) -> Effects:
+    """Effects + budget enforcement for one behaviour."""
+    eff = behaviour_effects(bdef, default_max_sends=default_max_sends)
+    if eff.sends > eff.max_sends:
+        raise VerifyError(
+            f"verify: behaviour {bdef} performs {eff.sends} sends but "
+            f"the type's budget is MAX_SENDS={eff.max_sends} "
+            "(≙ verify/fun.c rejecting the body)")
+    return eff
+
+
+def verify_program(program) -> Dict[str, Dict[str, Effects]]:
+    """The verify pass over every device cohort: {type: {behaviour:
+    Effects}}; raises VerifyError on budget violations. Budgets come
+    from the program's OWN resolution (cohort.max_sends), so the pass
+    enforces exactly what the engine will run."""
+    report: Dict[str, Dict[str, Effects]] = {}
+    for cohort in program.cohorts:
+        if cohort.host:
+            continue
+        ents: Dict[str, Effects] = {}
+        for bdef in cohort.behaviours:
+            eff = behaviour_effects(
+                bdef, cohort.atype,
+                default_max_sends=program.opts.max_sends)
+            if eff.sends > cohort.max_sends:
+                raise VerifyError(
+                    f"verify: behaviour {bdef} performs {eff.sends} "
+                    f"sends but the cohort's budget is "
+                    f"{cohort.max_sends} (≙ verify/fun.c)")
+            ents[bdef.name] = eff
+        report[cohort.atype.__name__] = ents
+    return report
