@@ -41,8 +41,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import (Bool, F32, I8, I16, I32, Iso, Ref, Tag,  # noqa
-                       TypeParam, U8, U16, U32, Val, VecF32,
+from .ops.pack import (Bool, Box, F32, I8, I16, I32, Iso, Mut, Ref,  # noqa
+                       Tag, Trn, TypeParam, U8, U16, U32, Val, VecF32,
                        VecI32)  # re-exported
 
 
@@ -64,6 +64,18 @@ class BehaviourDef:
                 else I32)
             for p in params)
         self.arg_names = tuple(p.name for p in params)
+        # Sendability (≙ safeto.c: behaviour/ctor parameters must be in
+        # CAP_SEND {iso, val, tag}, type/cap.c:90): a behaviour call IS
+        # a message, so a Trn/Mut/Box parameter could smuggle
+        # write-aliased state across an actor boundary.
+        for p, spec in zip(params, self.arg_specs):
+            m = pack.cap_mode(spec)
+            if not pack.cap_sendable(m):
+                raise TypeError(
+                    f"behaviour {fn.__name__}: parameter {p.name!r} is "
+                    f"{spec.__name__} — not sendable; only Iso, Val and "
+                    "Tag payloads may cross an actor boundary "
+                    "(CAP_SEND, type/cap.c:90; safeto.c)")
         # Filled in by program build:
         self.global_id: Optional[int] = None
         self.local_id: Optional[int] = None
@@ -500,6 +512,16 @@ class Context:
                     f"{src} payload into field {f!r} declared "
                     f"{dst.capitalize()} — a {src} value cannot grant "
                     f"the rights {dst} requires (is_cap_sub_cap)")
+            # The newborn is ANOTHER actor: a spawner-provenance value
+            # landing in its fields crosses an actor boundary, so it
+            # must be sendable — a trn/ref/box could otherwise smuggle
+            # a write-aliased payload out (CAP_SEND, safeto.c).
+            if src is not None and not pack.cap_sendable(src):
+                raise TypeError(
+                    f"capability: sync constructor {ctor} moves a "
+                    f"{src} payload into the newborn's field {f!r} — "
+                    f"{src} is not sendable; only iso/val/tag cross an "
+                    "actor boundary (CAP_SEND, type/cap.c:90)")
         self.sync_inits.setdefault(tname, {})[used] = (st2, ok)
         return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
 

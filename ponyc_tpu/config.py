@@ -115,6 +115,14 @@ class RuntimeOptions:
     #   single behaviour, no spawns/destroy/error/sync-construction;
     #   others fall back to the XLA path). The north-star dispatch
     #   kernel; off until measured on the real chip.
+    dispatch_gating: bool = False  # skip a behaviour's planar evaluation
+    #   under a scalar lax.cond when no lane's current batch slot selects
+    #   it (engine scan_body). Semantics-identical (behaviours are
+    #   lane-local by contract); pays one any-reduction + branch per
+    #   (slot, behaviour) to avoid evaluating cold behaviours — the
+    #   countermeasure to the planar-dispatch heterogeneity cliff
+    #   (profiling/_hetero.py measures; the reference's switch is O(1),
+    #   genfun.c). Off by default until measured on the real chip.
     delivery: str = "plan"         # delivery formulation (delivery.py):
     #   "plan"   — cached stable-sort plan + permutation gathers (skips
     #              the sort when traffic shape repeats);

@@ -84,15 +84,23 @@ def _slim_branch(bdef, field_specs, field_dtypes, msg_words, ms, lanes):
 def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
                          field_dtypes, field_specs, batch: int, cap: int,
                          msg_words: int, ms: int, rows: int,
-                         noyield: bool, interpret: bool):
+                         noyield: bool, interpret: bool,
+                         msg_words_in: int = None):
     """Returns fn(fields_tuple, buf, head, n_run, ids) →
     (new_fields_tuple, out_tgt [batch*ms*rows], out_words [w1, b*ms*rows],
     new_head [rows], nproc [rows], nbad [rows], ef [rows], ec [rows],
     ds [rows], erf [rows], erc [rows], erl [rows])
     with EXACTLY the XLA path's semantics (engine busy_fn ordering:
     entry (k, m, r) flattens k-major, then send slot, then lane; exit =
-    first wins, error = latest wins, destroy ORs across the batch)."""
+    first wins, error = latest wins, destroy ORs across the batch).
+
+    msg_words is the OUTBOX width (program-wide max); msg_words_in the
+    cohort's own mailbox width (per-type pony_msg_t, genfun.c) — the
+    mailbox tile read is [cap, 1+msg_words_in, LB]."""
+    if msg_words_in is None:
+        msg_words_in = msg_words
     w1 = 1 + msg_words
+    w1_in = 1 + msg_words_in
     lb = min(LANE_BLOCK, rows)
     assert rows % lb == 0, (rows, lb)
     nf = len(field_names)
@@ -129,7 +137,7 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         consumed = jnp.zeros((lb,), jnp.int32)
         for k in range(batch):
             slot = (head + k) % cap
-            msg = buf_ref[0]                     # [w1, LB]
+            msg = buf_ref[0]                     # [w1_in, LB]
             for c in range(1, cap):
                 msg = jnp.where((slot == c)[None, :], buf_ref[c], msg)
             valid = (nrun > k)
@@ -190,7 +198,7 @@ def build_fused_dispatch(bdefs, *, base_gid: int, field_names: Sequence[str],
         in_specs = (
             [pl.BlockSpec((1, lb), lambda i: (0, i))] * 3
             + [pl.BlockSpec((1, lb), lambda i: (0, i))] * nf
-            + [pl.BlockSpec((cap, w1, lb), lambda i: (0, 0, i))])
+            + [pl.BlockSpec((cap, w1_in, lb), lambda i: (0, 0, i))])
         outbox_specs = ([pl.BlockSpec((batch * ms, lb),
                                       lambda i: (0, i)),
                          pl.BlockSpec((batch * ms * w1, lb),

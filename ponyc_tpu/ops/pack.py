@@ -194,29 +194,41 @@ def type_params_of(specs) -> tuple:
 
 
 class _CapSpec:
-    """Host-payload capability annotation: Iso / Val / Tag.
+    """Host-payload capability annotation — the full six-cap lattice of
+    the reference (src/libponyc/type/cap.c:1, safeto.c:1, alias.c:1,
+    viewpoint.c:1):
 
-    ≙ the reference's reference-capability qualifiers on sendable
-    payloads (src/libponyc/type/cap.c:1, safeto.c:1, alias.c:1):
-
-    - ``Iso`` — moved-unique: the message MOVES the payload; the sender
-      provably loses access. Trace-time discipline (api.Context.send +
-      engine.eval_behaviour) rejects aliased moves (same handle sent
-      twice in one dispatch), use-after-move, and retained-after-move
-      (returning a moved handle in state). Dynamically, HostHeap
-      handles are move-only (unbox consumes) and in-flight handles
-      reject peek/unbox (use-after-send).
+    - ``Iso`` — moved-unique (read+write, no aliases): the message MOVES
+      the payload; the sender provably loses access. Trace-time
+      discipline (api.Context.send + engine.eval_behaviour) rejects
+      aliased moves (same handle sent twice in one dispatch),
+      use-after-move, and retained-after-move (returning a moved handle
+      in state). Dynamically, HostHeap handles are move-only (unbox
+      consumes) and in-flight handles reject peek/unbox.
+    - ``Trn`` — transition (write-unique, read-aliasable): one writer;
+      read-only ``Box`` views may alias it. NOT sendable. A store into
+      a Trn/Mut/Val slot CONSUMES it (≙ `consume` — trn→val is Pony's
+      freeze); a store into Box/Tag aliases it.
+    - ``Mut`` — locally mutable, freely aliasable within the actor
+      (≙ Pony's ``ref``; renamed here because `Ref` is this framework's
+      actor-reference annotation). NOT sendable.
     - ``Val`` — shared-immutable: anyone may read (peek), nobody may
-      take ownership (unbox rejects); aliasing freely allowed.
+      take ownership (unbox rejects); aliasing freely allowed. Sendable.
+    - ``Box`` — read-only view (≙ box): may read, never write; the
+      local "either val or ref underneath" window. NOT sendable.
     - ``Tag`` — opaque address: identity/forwarding only; peek AND
-      unbox reject.
+      unbox reject. Sendable.
 
-    The wire word is a HostHeap handle (i32); the mode governs the
-    trace-time move discipline and the dynamic handle rules."""
+    Only {iso, val, tag} may cross an actor boundary (message/ctor
+    parameters) — exactly the reference's CAP_SEND set
+    (type/cap.c:90, safeto.c). The wire word is a HostHeap handle
+    (i32); the mode governs the trace-time move/alias discipline and
+    the dynamic handle rules (hostmem.py)."""
 
     __slots__ = ("mode",)
 
-    _NAMES = {"iso": "Iso", "val": "Val", "tag": "Tag"}
+    _NAMES = {"iso": "Iso", "trn": "Trn", "ref": "Mut", "val": "Val",
+              "box": "Box", "tag": "Tag"}
 
     def __init__(self, mode: str):
         self.mode = mode
@@ -230,13 +242,59 @@ class _CapSpec:
 
 
 Iso = _CapSpec("iso")
+Trn = _CapSpec("trn")
+Mut = _CapSpec("ref")      # ≙ Pony `ref` (the name Ref is taken by actor refs)
 Val = _CapSpec("val")
+Box = _CapSpec("box")
 Tag = _CapSpec("tag")
+
+# ≙ TK_CAP_SEND {iso, val, tag} (type/cap.c:90): the caps a value may
+# carry across an actor boundary.
+SENDABLE_CAPS = frozenset(("iso", "val", "tag"))
 
 
 def cap_mode(ann):
-    """'iso' / 'val' / 'tag' for capability specs, else None."""
+    """'iso'/'trn'/'ref'/'val'/'box'/'tag' for capability specs, else
+    None."""
     return ann.mode if isinstance(ann, _CapSpec) else None
+
+
+def cap_sendable(mode) -> bool:
+    """May a value of this mode ride a message parameter?
+    (≙ safeto.c sendability; None = uncapped word, always fine.)"""
+    return mode is None or mode in SENDABLE_CAPS
+
+
+def cap_alias(mode):
+    """The capability of an ALIAS of a value (≙ cap_aliasing with
+    TK_ALIASED, type/alias.c): iso aliases as tag (the unique original
+    keeps its rights), trn aliases as box (write-uniqueness preserved),
+    everything else aliases as itself."""
+    return {"iso": "tag", "trn": "box"}.get(mode, mode)
+
+
+def viewpoint(origin, field):
+    """Viewpoint adaptation origin▷field (≙ cap_view_upper,
+    type/cap.c:581-711, concrete caps, non-ephemeral): the capability a
+    reader holding `origin` sees when reading a `field`-capped slot.
+    Returns None when the origin cannot read at all (tag origin)."""
+    if origin is None or field is None:
+        return field             # gradual: uncapped side ⇒ no adaptation
+    if origin == "tag":
+        return None              # can't see through a tag (cap.c:588-596)
+    if field == "tag":
+        return "tag"             # a tag is always seen as a tag
+    if origin == "iso":
+        return {"iso": "iso", "val": "val"}.get(field, "tag")
+    if origin == "trn":
+        return {"iso": "iso", "trn": "trn", "val": "val"}.get(field, "box")
+    if origin == "ref":
+        return field             # ref▷T = T
+    if origin == "val":
+        return "val"
+    if origin == "box":
+        return {"iso": "tag", "val": "val"}.get(field, "box")
+    raise ValueError(f"unknown capability mode {origin!r}")
 
 
 def concrete_null_handle(a) -> bool:
@@ -250,16 +308,27 @@ def concrete_null_handle(a) -> bool:
         return False
 
 
-# The store lattice (≙ is_cap_sub_cap, type/cap.c — the sendable
-# fragment): a value of mode SRC may be stored where DST is declared
-# when SRC's rights cover DST's. iso (unique, all rights) may be
-# downgraded to anything — THAT STORE IS A MOVE. val (shared read)
-# may stay val or drop to tag. tag (address only) stays tag.
+# The store lattice (≙ is_cap_sub_cap, type/cap.c:59-160, all six
+# caps): a value of mode SRC may be stored where DST is declared when
+# SRC's rights cover DST's. Unique caps store as MOVES (consume):
+# iso^ is sub of everything; trn^ of everything but iso (cap.c:99-113,
+# trn→val being Pony's freeze). The alias caps follow the sub chains
+# ref <: box, val <: box, box <: tag exactly (cap.c:115-160; super tag
+# always true, cap.c:73-74).
 _CAP_STORE_OK = {
-    ("iso", "iso"): True, ("iso", "val"): True, ("iso", "tag"): True,
-    ("val", "iso"): False, ("val", "val"): True, ("val", "tag"): True,
-    ("tag", "iso"): False, ("tag", "val"): False, ("tag", "tag"): True,
+    "iso": {"iso", "trn", "ref", "val", "box", "tag"},   # moved (iso^)
+    "trn": {"trn", "ref", "val", "box", "tag"},          # moved (trn^)
+    "ref": {"ref", "box", "tag"},
+    "val": {"val", "box", "tag"},
+    "box": {"box", "tag"},
+    "tag": {"tag"},
 }
+
+# The dst caps whose store CONSUMES a unique src (ownership/write
+# rights transfer): everything that grants more than read-alias rights.
+# A trn stored into box/tag merely aliases (read view / address) and
+# the original stays writable — ≙ trn <: box needing no consume.
+CONSUMING_DSTS = frozenset(("iso", "trn", "ref", "val"))
 
 
 def cap_store_ok(src_mode, dst_mode) -> bool:
@@ -267,7 +336,7 @@ def cap_store_ok(src_mode, dst_mode) -> bool:
     Unknown provenance (None) is gradual — allowed."""
     if src_mode is None or dst_mode is None:
         return True
-    return _CAP_STORE_OK[(src_mode, dst_mode)]
+    return dst_mode in _CAP_STORE_OK[src_mode]
 
 
 class CapMoves:
@@ -396,8 +465,10 @@ def normalize_annotation(ann):
     capability instance)."""
     if isinstance(ann, (_RefTo, _VecSpec, _CapSpec, TypeParam)):
         return ann
-    if isinstance(ann, str) and ann in ("Iso", "Val", "Tag"):
-        return {"Iso": Iso, "Val": Val, "Tag": Tag}[ann]
+    if isinstance(ann, str) and ann in ("Iso", "Trn", "Mut", "Val",
+                                        "Box", "Tag"):
+        return {"Iso": Iso, "Trn": Trn, "Mut": Mut, "Val": Val,
+                "Box": Box, "Tag": Tag}[ann]
     if ann in _MARKERS:
         return ann
     if isinstance(ann, str) and ann.endswith("]"):

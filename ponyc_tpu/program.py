@@ -52,6 +52,17 @@ class Cohort:
         # trace, not silently at run.
         self.max_sends = getattr(atype, "MAX_SENDS", None) or opts.max_sends
         self.behaviours = list(atype.behaviour_defs)
+        # Per-cohort mailbox word width (≙ per-type pony_msg_t sizes —
+        # genfun.c packs exactly each behaviour's params; the reference
+        # never pays one type's width for another's messages). The
+        # cohort's mailbox table holds only what its own behaviours can
+        # receive: min(opts.msg_words, widest behaviour). opts.msg_words
+        # stays the program-wide declared maximum (outbox/spill/inject
+        # width); narrower cohorts just stop paying HBM for it.
+        from .ops.pack import spec_width
+        need = max((sum(spec_width(s) for s in b.arg_specs)
+                    for b in self.behaviours), default=0)
+        self.msg_words = min(opts.msg_words, need)
         self.n_local_total = 0      # rows per shard over all cohorts (set later)
         # Resolved by Program.finalize():
         self.spawns: Dict[str, int] = {}     # target type name → sites/dispatch

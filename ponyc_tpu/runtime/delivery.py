@@ -55,7 +55,7 @@ class Entries(NamedTuple):
 
 
 class DeliveryResult(NamedTuple):
-    buf: jnp.ndarray
+    buf: dict                  # {type: [cap, 1+W_c, rows_c]} per cohort
     tail: jnp.ndarray
     spill: Entries             # rejected entries, compacted, oldest first
     spill_count: jnp.ndarray   # [] int32
@@ -95,10 +95,16 @@ def empty_mute_slots(n: int, k: int):
 
 def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
-            shard_base, mute_slots: int = 4, level=None, n_levels: int = 1,
-            plan=None, pressured=None, cosort: bool = False
-            ) -> DeliveryResult:
-    """`level` ([E] int32, 0 = most urgent) folds the fork's actor
+            shard_base, cohort_layout, mute_slots: int = 4, level=None,
+            n_levels: int = 1, plan=None, pressured=None,
+            cosort: bool = False) -> DeliveryResult:
+    """`buf` is the per-cohort mailbox dict {type: [cap, 1+W_c, rows_c]};
+    `cohort_layout` = [(type, s0, s1, w1_c)] tiles the local row space
+    [0, n_local) in cohort order — bookkeeping (tails, segments, spill)
+    stays global over rows, only the table rebuild is per cohort at its
+    own width (≙ per-type pony_msg_t sizes, genfun.c).
+
+    `level` ([E] int32, 0 = most urgent) folds the fork's actor
     *priorities* (actor.h priority hint; scheduler.c:1053-1078 priority
     inject) into the one sort: the composite key (target, level, arrival)
     keeps per-target segments contiguous while ordering contenders by
@@ -205,20 +211,23 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
         new_tail = tail + acc
 
         # Slot-plane ring rebuild: plane c (ring slot c of every actor)
-        # pulls sorted entry seg_start + (c - tail) % cap. All planes'
-        # indices concatenate into ONE gather (a single [w1, cap*n]
-        # pull), then per-plane selects against the old buf — one gather
-        # op instead of `cap`, so any fixed per-gather lowering cost on
-        # TPU is paid once.
+        # pulls sorted entry seg_start + (c - tail) % cap. Per COHORT,
+        # at the cohort's own word width: each table's gather touches
+        # [w1_c, cap*rows_c] — a narrow type's rebuild never moves the
+        # widest type's words (the HBM win of per-cohort widths). Within
+        # a cohort all planes' indices still concatenate into ONE gather.
         rels = (jnp.arange(c, dtype=jnp.int32)[:, None]
                 - tail[None, :]) % c                 # [cap, n]
         wmasks = rels < acc[None, :]
         srcs = jnp.minimum(seg_start[None, :] + rels, e - 1)
-        pulled = jnp.take(wds, srcs.reshape(c * n), axis=1).reshape(
-            w1, c, n)
-        buf2 = jnp.where(wmasks[:, None, :],            # [cap, 1, n]
-                         pulled.transpose(1, 0, 2),     # [cap, w1, n]
-                         buf)
+        buf2 = {}
+        for cname, s0, s1, w1c in cohort_layout:
+            nn = s1 - s0
+            pulled = jnp.take(wds[:w1c], srcs[:, s0:s1].reshape(c * nn),
+                              axis=1).reshape(w1c, c, nn)
+            buf2[cname] = jnp.where(wmasks[:, None, s0:s1],
+                                    pulled.transpose(1, 0, 2),
+                                    buf[cname])
 
         n_delivered = jnp.sum(acc)
         nrej = jnp.sum(cnt - acc)

@@ -170,11 +170,22 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
                 "(is_cap_sub_cap, type/cap.c)")
     # An iso-provenance value stored into MORE THAN ONE field aliases a
     # unique (≙ alias.c): every field keeping it is a distinct owner.
+    # A trn is WRITE-unique (cap.c): keeping it in the field it came
+    # from is free, and Box/Tag stores alias it (read views — Pony's
+    # trn+box sharing); but a CONSUMING store into a *different*
+    # Trn/Mut/Val field (ownership/freeze, ≙ consume) must be the
+    # value's only remaining appearance.
+    origin_field = {}
+    for k, v in st.items():
+        origin_field.setdefault(id(v), k)
     iso_seen = {}
+    trn_consumed = {}
+    trn_retained = {}      # keeps + aliases (anything but the consume)
     for k, v in st2.items():
         if pack.concrete_null_handle(v):
             continue
-        if ctx.cap_types.lookup(v) == "iso":
+        src = ctx.cap_types.lookup(v)
+        if src == "iso":
             first = iso_seen.get(id(v))
             if first is not None:
                 raise TypeError(
@@ -182,6 +193,28 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
                     f"payload into BOTH fields {first!r} and {k!r} — "
                     "an iso has exactly one owner (alias.c)")
             iso_seen[id(v)] = k
+        elif src == "trn":
+            dst = pack.cap_mode(field_specs[k])
+            consuming = (dst in pack.CONSUMING_DSTS
+                         and origin_field.get(id(v)) != k)
+            if consuming:
+                first = trn_consumed.get(id(v))
+                if first is not None:
+                    raise TypeError(
+                        f"capability: behaviour {bdef} consumes one trn "
+                        f"payload into BOTH fields {first!r} and {k!r} "
+                        "— a trn is write-unique (cap.c); alias it Box "
+                        "for read sharing")
+                trn_consumed[id(v)] = k
+            else:
+                trn_retained.setdefault(id(v), k)
+    for idv, kc in trn_consumed.items():
+        ka = trn_retained.get(idv)
+        if ka is not None:
+            raise TypeError(
+                f"capability: behaviour {bdef} consumes a trn payload "
+                f"into field {kc!r} and ALSO retains it in {ka!r} — "
+                "use-after-consume (alias.c)")
     st2 = {k: _bcast_lanes(v, field_dtypes[k], lanes)
            for k, v in st2.items()}
     if len(ctx.sends) > max_sends:
@@ -282,12 +315,16 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     vector ops (actors on the 128 TPU lanes, batch slots iterated by a
     lax.scan whose carries are all lane-shaped).
     """
-    msg_words = opts.msg_words
+    msg_words = opts.msg_words          # OUTBOX width (program-wide max)
     ms = cohort.max_sends
     batch = cohort.batch
     cap = opts.mailbox_cap
     rows = cohort.local_capacity
     w1 = 1 + msg_words
+    # This cohort's own mailbox width (≙ per-type pony_msg_t, genfun.c):
+    # the drain reads [cap, w1_in, rows]; sends still emit the global
+    # width (they may target any cohort — delivery narrows per target).
+    w1_in = 1 + cohort.msg_words
     field_dtypes = {}
     for fname, spec in cohort.atype.field_specs.items():
         field_dtypes[fname] = (jnp.float32 if spec is pack.F32
@@ -318,7 +355,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     br,
                     {f: jax.ShapeDtypeStruct((rows,), field_dtypes[f])
                      for f in cohort.atype.field_specs},
-                    jax.ShapeDtypeStruct((msg_words, rows), jnp.int32),
+                    jax.ShapeDtypeStruct((cohort.msg_words, rows),
+                                         jnp.int32),
                     jax.ShapeDtypeStruct((rows,), jnp.int32), {})
             if fd.eligible(cohort, effects, opts):
                 fnames = tuple(cohort.atype.field_specs.keys())
@@ -326,7 +364,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     cohort.behaviours, base_gid=base,
                     field_names=fnames, field_dtypes=field_dtypes,
                     field_specs=cohort.atype.field_specs, batch=batch,
-                    cap=cap, msg_words=msg_words, ms=ms, rows=rows,
+                    cap=cap, msg_words=msg_words,
+                    msg_words_in=cohort.msg_words, ms=ms, rows=rows,
                     noyield=noyield, interpret=mk.interpret_mode()),
                     fnames)
 
@@ -380,32 +419,59 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                                   -1 if pack.is_ref(sp) else 0, t_dt[f])
                          for _ in range(n)]
                      for f, sp in t_specs.items()}))
+            def _merge(br, take, acc):
+                """Evaluate one behaviour planar and select its outputs
+                where the slot's message id matches."""
+                (st_a, tgt_a, wrd_a, ef_a, ec_a, yf_a, sf_a, ds_a,
+                 erf_a, erc_a, erl_a, clm_a, ini_a) = acc
+                (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf,
+                 bds, (berf, berc, berl)) = br(st, msg[1:], ids, resv_k)
+                st_o = {k: jnp.where(take, st2[k], st_a[k]) for k in st_a}
+                tgt_o = [jnp.where(take, btgt[m], tgt_a[m])
+                         for m in range(ms)]
+                wrd_o = [jnp.where(take[None, :], bwrd[m], wrd_a[m])
+                         for m in range(ms)]
+                clm_o = [[jnp.where(take, bclm[si][s], clm_a[si][s])
+                          for s in range(len(clm_a[si]))]
+                         for si in range(len(spawn_sites))]
+                ini_o = []
+                for si in range(len(spawn_sites)):
+                    bh, bv = bini[si]
+                    hh, vv = ini_a[si]
+                    ini_o.append((
+                        [jnp.where(take, bh[s], hh[s])
+                         for s in range(len(hh))],
+                        {f: [jnp.where(take, bv[f][s], vv[f][s])
+                             for s in range(len(vv[f]))] for f in vv}))
+                return (st_o, tgt_o, wrd_o,
+                        jnp.where(take, bef, ef_a),
+                        jnp.where(take, bec, ec_a),
+                        jnp.where(take, byf, yf_a),
+                        jnp.where(take, bsf, sf_a),
+                        jnp.where(take, bds, ds_a),
+                        jnp.where(take, berf, erf_a),
+                        jnp.where(take, berc, erc_a),
+                        jnp.where(take, berl, erl_a),
+                        clm_o, ini_o)
+
+            acc = (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
+                   erf_n, erc_n, erl_n, clm_n, ini_n)
             for j, br in enumerate(branches):
                 take = (do & in_range & (local == j))
-                (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf, bds,
-                 (berf, berc, berl)) = br(st, msg[1:], ids, resv_k)
-                for k in st_n:
-                    st_n[k] = jnp.where(take, st2[k], st_n[k])
-                for m in range(ms):
-                    tgt_n[m] = jnp.where(take, btgt[m], tgt_n[m])
-                    wrd_n[m] = jnp.where(take[None, :], bwrd[m], wrd_n[m])
-                ef_n = jnp.where(take, bef, ef_n)
-                ec_n = jnp.where(take, bec, ec_n)
-                yf_n = jnp.where(take, byf, yf_n)
-                sf_n = jnp.where(take, bsf, sf_n)
-                ds_n = jnp.where(take, bds, ds_n)
-                erf_n = jnp.where(take, berf, erf_n)
-                erc_n = jnp.where(take, berc, erc_n)
-                erl_n = jnp.where(take, berl, erl_n)
-                for si, (_, n) in enumerate(spawn_sites):
-                    bh, bv = bini[si]
-                    hh, vv = ini_n[si]
-                    for s in range(n):
-                        clm_n[si][s] = jnp.where(take, bclm[si][s],
-                                                 clm_n[si][s])
-                        hh[s] = jnp.where(take, bh[s], hh[s])
-                        for f in vv:
-                            vv[f][s] = jnp.where(take, bv[f][s], vv[f][s])
+                if opts.dispatch_gating:
+                    # Skip a cold behaviour's whole planar evaluation
+                    # under a scalar cond (≙ the generated dispatch
+                    # switch running only the selected case, genfun.c).
+                    # Behaviour bodies are lane-local by contract, so a
+                    # shard-divergent predicate is safe.
+                    acc = lax.cond(
+                        jnp.any(take),
+                        lambda a, _br=br, _t=take: _merge(_br, _t, a),
+                        lambda a: a, acc)
+                else:
+                    acc = _merge(br, take, acc)
+            (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
+             erf_n, erc_n, erl_n, clm_n, ini_n) = acc
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -658,6 +724,11 @@ def build_step(program: Program, opts: RuntimeOptions):
     prio_row_np = _np.zeros((nl,), _np.int32)
     for ch in dev_cohorts:
         prio_row_np[ch.local_start:ch.local_stop] = pri_rank[ch.priority]
+    # Per-cohort mailbox widths tiling the local row space (ALL cohorts,
+    # device + host) — delivery rebuilds each table at its own width.
+    cohort_layout = tuple(
+        (ch.atype.__name__, ch.local_start, ch.local_stop,
+         1 + ch.msg_words) for ch in program.cohorts)
 
     def local_step(st: RtState, inject_tgt, inject_words
                    ) -> Tuple[RtState, StepAux]:
@@ -667,14 +738,32 @@ def build_step(program: Program, opts: RuntimeOptions):
             shard = jnp.int32(0)
         base = shard * nl
         occ0 = st.tail - st.head
+        # World bits (previous tick's mesh-wide vote, stored replicated
+        # per shard): bit0 = any actor pressured anywhere, bit1 = any
+        # muted anywhere, bit2 = any route-spill entries anywhere. They
+        # are shard-uniform by construction (computed from the packed
+        # psum vote below; host writes set every shard's entry), so they
+        # can gate collectives — every shard takes the same cond branch,
+        # the same uniformity argument as the fused window's while cond.
+        # This is the fork's whole thesis applied to the mesh
+        # (README.md:8-10): a quiet world must not pay per-tick gather
+        # latency for backpressure machinery it isn't using.
+        wb0 = st.world_bits[0]
+        world_pressured = (wb0 & 1) > 0
+        world_muted = (wb0 & 2) > 0
+        world_rspill = (wb0 & 4) > 0
         # Mesh-wide pressured bits (≙ pony_apply_backpressure being
         # visible to every scheduler): one all_gather of the [nl] bool
-        # column per tick — bandwidth-trivial next to the routing
-        # all_to_all, and it lets BOTH the routing mute and the remote
-        # unmute guard see off-shard pressure.
+        # column — it lets BOTH the routing mute and the remote unmute
+        # guard see off-shard pressure. Gated: ticks on a mesh with no
+        # declared pressure anywhere skip the gather (zeros are exact).
         if p > 1:
-            pressured_global = lax.all_gather(
-                st.pressured, "actors", tiled=True)
+            pressured_global = lax.cond(
+                world_pressured,
+                lambda _: lax.all_gather(st.pressured, "actors",
+                                         tiled=True),
+                lambda _: jnp.zeros((p * nl,), jnp.bool_),
+                operand=None)
         else:
             pressured_global = st.pressured
 
@@ -700,9 +789,16 @@ def build_step(program: Program, opts: RuntimeOptions):
                      & can_recover)
         muter_bits = (live_cong.astype(jnp.int32)
                       | (can_recover.astype(jnp.int32) << 1))
+        # Gated like the pressured gather: the bits feed only the unmute
+        # pass, which has work only when someone (anywhere) is muted —
+        # exactly what world bit1 reports from the previous tick's vote.
         if p > 1:
-            muter_bits_global = lax.all_gather(muter_bits, "actors",
-                                               tiled=True)
+            muter_bits_global = lax.cond(
+                world_muted,
+                lambda _: lax.all_gather(muter_bits, "actors",
+                                         tiled=True),
+                lambda _: jnp.zeros((p * nl,), jnp.int32),
+                operand=None)
         else:
             muter_bits_global = muter_bits
         live_cong_global = (muter_bits_global & 1) > 0
@@ -831,11 +927,17 @@ def build_step(program: Program, opts: RuntimeOptions):
             # deliver the stale message to the newborn. Make every shard's
             # rspill targets globally visible (one psum over the mesh) —
             # the cross-shard twin of the dspill_pending guard below.
-            rhit = jnp.zeros((p * nl,), jnp.int32).at[
-                jnp.maximum(st.rspill_tgt, 0)].max(
-                (st.rspill_tgt >= 0).astype(jnp.int32), mode="drop")
-            rhit = lax.psum(rhit, "actors")
-            rspill_hit = lax.dynamic_slice(rhit, (base,), (nl,)) > 0
+            # Gated on world bit2: with every shard's route-spill empty
+            # (the steady state) the psum is skipped and zeros are exact.
+            def _rhit(_):
+                rhit = jnp.zeros((p * nl,), jnp.int32).at[
+                    jnp.maximum(st.rspill_tgt, 0)].max(
+                    (st.rspill_tgt >= 0).astype(jnp.int32), mode="drop")
+                rhit = lax.psum(rhit, "actors")
+                return lax.dynamic_slice(rhit, (base,), (nl,)) > 0
+            rspill_hit = lax.cond(
+                world_rspill, _rhit,
+                lambda _: jnp.zeros((nl,), jnp.bool_), operand=None)
         else:
             rspill_hit = jnp.zeros((nl,), jnp.bool_)
         for tname in program.spawn_target_names:
@@ -898,7 +1000,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, inits,
              sfail, dstr, errs) = run_cohort(
                 st.type_state[ch.atype.__name__],
-                st.buf[:, :, s0:s1], st.head[s0:s1], occ0[s0:s1],
+                st.buf[ch.atype.__name__], st.head[s0:s1], occ0[s0:s1],
                 runnable[s0:s1], ids, cohort_resv(ch))
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
@@ -1025,6 +1127,7 @@ def build_step(program: Program, opts: RuntimeOptions):
         res = deliver(st.buf, new_head, tail0, alive, all_e,
                       n_local=nl, mailbox_cap=c, spill_cap=s_cap,
                       overload_occ=opts.overload_occ, shard_base=base,
+                      cohort_layout=cohort_layout,
                       mute_slots=opts.mute_slots,
                       level=lvl_all, n_levels=n_levels,
                       plan=(st.plan_key, st.plan_perm, st.plan_bounds),
@@ -1184,33 +1287,48 @@ def build_step(program: Program, opts: RuntimeOptions):
         # Sticky: once any step overflowed, every later aux reports it, so
         # the host catches it whatever its fetch cadence (quiesce_interval).
         overflow = st.spill_overflow[0] | res.spill_overflow | rsp_over
+        # End-of-tick facts feeding the next tick's gather gates (exact,
+        # not conservative: `pressured`/`muted2` are post-destroy finals,
+        # `rsp_count` is the post-route spill count).
+        any_pressured_local = jnp.any(pressured)
+        any_rspill_local = rsp_count > 0
         if p > 1:
-            spawn_fail_any = lax.psum(
-                spawn_fail.astype(jnp.int32), "actors") > 0
-            device_pending = lax.psum(
-                local_pending.astype(jnp.int32), "actors") > 0
-            any_muted_all = lax.psum(
-                any_muted_local.astype(jnp.int32), "actors") > 0
-            host_pending = lax.psum(
-                host_pending.astype(jnp.int32), "actors") > 0
-            exit_any = lax.psum(exit_f.astype(jnp.int32), "actors") > 0
-            exit_code_all = lax.pmax(
-                jnp.where(exit_f, exit_c, jnp.int32(-2**31)), "actors")
-            exit_code_all = jnp.where(exit_any, exit_code_all, exit_c)
-            overflow_any = lax.psum(
-                overflow.astype(jnp.int32), "actors") > 0
-            nproc_all = lax.psum(st.n_processed[0] + nproc_total, "actors")
-            ndel_all = lax.psum(st.n_delivered[0] + res.n_delivered,
-                                "actors")
+            # ONE packed psum + ONE packed pmax replace the former ~17
+            # separate collectives (≙ the CNF/ACK token protocol being a
+            # single token, not one message per fact, scheduler.c:303-480).
+            # Booleans ride as 0/1 counts ("any" = sum > 0); cumulative
+            # counters wrap mod 2^32 exactly as the per-shard counters do.
+            i32c = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
+            summed = lax.psum(jnp.stack([
+                i32c(spawn_fail), i32c(local_pending),
+                i32c(any_muted_local), i32c(host_pending),
+                i32c(exit_f), i32c(overflow),
+                i32c(any_pressured_local), i32c(any_rspill_local),
+                st.n_processed[0] + nproc_total,
+                st.n_delivered[0] + res.n_delivered,
+                occ_sum, n_muted_now, n_over_now,
+                nrej_all, nbad_all, ndl_all, nmut_all]), "actors")
+            spawn_fail_any = summed[0] > 0
+            device_pending = summed[1] > 0
+            any_muted_all = summed[2] > 0
+            host_pending = summed[3] > 0
+            exit_any = summed[4] > 0
+            overflow_any = summed[5] > 0
+            any_pressured_all = summed[6] > 0
+            any_rspill_all = summed[7] > 0
+            nproc_all = summed[8]
+            ndel_all = summed[9]
             if opts.analysis >= 1:
-                occ_sum = lax.psum(occ_sum, "actors")
-                occ_max = lax.pmax(occ_max, "actors")
-                n_muted_now = lax.psum(n_muted_now, "actors")
-                n_over_now = lax.psum(n_over_now, "actors")
-                nrej_all = lax.psum(nrej_all, "actors")
-                nbad_all = lax.psum(nbad_all, "actors")
-                ndl_all = lax.psum(ndl_all, "actors")
-                nmut_all = lax.psum(nmut_all, "actors")
+                occ_sum, n_muted_now, n_over_now = (summed[10], summed[11],
+                                                    summed[12])
+                nrej_all, nbad_all, ndl_all, nmut_all = (
+                    summed[13], summed[14], summed[15], summed[16])
+            maxed = lax.pmax(jnp.stack([
+                jnp.where(exit_f, exit_c, jnp.int32(-2**31)), occ_max]),
+                "actors")
+            exit_code_all = jnp.where(exit_any, maxed[0], exit_c)
+            if opts.analysis >= 1:
+                occ_max = maxed[1]
         else:
             spawn_fail_any = spawn_fail
             device_pending = local_pending
@@ -1218,8 +1336,13 @@ def build_step(program: Program, opts: RuntimeOptions):
             exit_any = exit_f
             exit_code_all = exit_c
             overflow_any = overflow
+            any_pressured_all = any_pressured_local
+            any_rspill_all = any_rspill_local
             nproc_all = st.n_processed[0] + nproc_total
             ndel_all = st.n_delivered[0] + res.n_delivered
+        wb_new = (any_pressured_all.astype(jnp.int32)
+                  | (any_muted_all.astype(jnp.int32) << 1)
+                  | (any_rspill_all.astype(jnp.int32) << 2))
 
         def vec(x, dtype=None):   # per-shard "scalar" → [1]
             return jnp.asarray(x, dtype).reshape(1)
@@ -1254,6 +1377,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             ev_dropped=vec(ev_dropped),
             plan_key=res.plan_key, plan_perm=res.plan_perm,
             plan_bounds=res.plan_bounds,
+            world_bits=vec(wb_new),
             type_state=new_type_state,
         )
         aux = StepAux(

@@ -130,22 +130,32 @@ def build_gc(program: Program, opts: RuntimeOptions):
                 col = st.type_state[cohort.atype.__name__][fname]
                 field_edges.append((cohort.local_start, cohort.local_stop,
                                     col.astype(jnp.int32)))
-        # Mailbox edges: ref args of queued messages. Planar over the
-        # [cap, w1, nl] table: ring slot ci holds a live message iff
-        # (ci - head) mod cap < occupancy; each payload word that the
-        # static ref mask marks contributes a [nl] target plane.
+        # Mailbox edges: ref args of queued messages. Planar over each
+        # cohort's [cap, w1_c, rows] table (per-cohort widths): ring slot
+        # ci holds a live message iff (ci - head) mod cap < occupancy;
+        # each payload word that the static ref mask marks contributes a
+        # [rows_c]-wide plane padded into an [nl] lane (targets are -1
+        # outside the cohort's rows).
         if any_ref_args:
             mb_planes = []                                # [nl] each
             rmask = jnp.asarray(ref_mask_np)
-            for ci in range(cap):
-                valid = ((ci - st.head) % cap) < occ
-                gid = st.buf[ci, 0]
-                g = jnp.clip(gid, 0, n_gids - 1)
-                inr = valid & (gid >= 0) & (gid < n_gids)
-                for w in range(st.buf.shape[1] - 1):
-                    rm = rmask[g, w] & inr
-                    mb_planes.append(jnp.where(rm, st.buf[ci, 1 + w], -1))
-            mb_tgt = jnp.stack(mb_planes)                 # [cap*W, nl]
+            for cohort in program.cohorts:
+                cbuf = st.buf[cohort.atype.__name__]
+                s0, s1 = cohort.local_start, cohort.local_stop
+                if cbuf.shape[1] <= 1:
+                    continue                   # gid-only mailboxes: no refs
+                for ci in range(cap):
+                    valid = ((ci - st.head[s0:s1]) % cap) < occ[s0:s1]
+                    gid = cbuf[ci, 0]
+                    g = jnp.clip(gid, 0, n_gids - 1)
+                    inr = valid & (gid >= 0) & (gid < n_gids)
+                    for w in range(cbuf.shape[1] - 1):
+                        rm = rmask[g, w] & inr
+                        plane = jnp.full((nl,), -1, jnp.int32).at[
+                            s0 + jnp.arange(s1 - s0)].set(
+                            jnp.where(rm, cbuf[ci, 1 + w], -1))
+                        mb_planes.append(plane)
+            mb_tgt = jnp.stack(mb_planes) if mb_planes else None
         else:
             mb_tgt = None
 
@@ -227,6 +237,10 @@ def build_gc(program: Program, opts: RuntimeOptions):
             # collected actors invalidate it by comparison, not here.
             plan_key=st.plan_key, plan_perm=st.plan_perm,
             plan_bounds=st.plan_bounds,
+            # Collection can only CLEAR muted/pressured bits (dead rows);
+            # stale-high world bits cost one extra gather next tick and
+            # the vote then corrects them.
+            world_bits=st.world_bits,
             type_state=st.type_state,
         )
         if p > 1:

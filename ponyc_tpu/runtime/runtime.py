@@ -385,6 +385,10 @@ class Runtime:
         stalled socket, a full external queue). stdlib/backpressure.py
         wraps this with the reference package's auth-token surface."""
         self._set_flag_column("pressured", ids, True)
+        # Raise the mesh-wide "any pressure" gate bit on every shard so
+        # the next tick's (otherwise-skipped) pressured all_gather runs;
+        # the per-tick vote keeps it honest from then on (engine.py).
+        self.state = self._replace(world_bits=self.state.world_bits | 1)
 
     def release_backpressure(self, ids) -> None:
         """Clear UNDER_PRESSURE (≙ pony_release_backpressure); muted
@@ -591,10 +595,19 @@ class Runtime:
                 f"(first target {int(full[0])}); drain with run() first or "
                 "raise mailbox_cap")
         slot = t_at % self.opts.mailbox_cap
-        # buf is [cap, w1, N] (actor-lane minor; state.py layout note):
-        # advanced indices (slot, target) pair up, the word axis rides.
+        # Per-cohort mailbox tables (state.py): all targets live in ONE
+        # cohort (checked above); write its table at its own width (the
+        # packed words beyond it are zeros by construction — this
+        # behaviour's args fit the cohort's width). Advanced indices
+        # (slot, col) pair up, the word axis rides.
+        cname = behaviour_def.actor_type.__name__
+        cohort = self.program.by_type_name(cname)
+        cols = np.asarray(cohort.gid_to_col(targets))
+        w1c = 1 + cohort.msg_words
+        new_cbuf = self.state.buf[cname].at[slot, :, cols].set(
+            jnp.asarray(words[:, :w1c]))
         self.state = self._replace(
-            buf=self.state.buf.at[slot, :, targets].set(jnp.asarray(words)),
+            buf={**self.state.buf, cname: new_cbuf},
             tail=tail.at[targets].add(1))
 
     def _drain_inject(self):
@@ -725,15 +738,23 @@ class Runtime:
         pending = tail - head
         if not pending.any():
             return False
-        buf = np.asarray(self.state.buf[:, :, rows_j])  # [cap, w1, R]
+        # Per-cohort mailbox tables: fetch each HOST cohort's table once
+        # (at its own width) and read messages via cohort-local columns.
+        host_bufs: Dict[str, np.ndarray] = {}
         c = self.opts.mailbox_cap
         new_head = head.copy()
         for i in np.nonzero(pending)[0]:
             aid = int(rows[int(i)])
             cohort = self.program.cohort_of(aid)
+            cname = cohort.atype.__name__
+            cbuf = host_bufs.get(cname)
+            if cbuf is None:
+                cbuf = host_bufs[cname] = np.asarray(
+                    self.state.buf[cname])       # [cap, w1_c, capacity]
+            col = int(cohort.gid_to_col(aid))
             consumed = 0
             for k in range(int(pending[i])):
-                msg = buf[(head[i] + k) % c, :, i]
+                msg = cbuf[(head[i] + k) % c, :, col]
                 consumed += 1
                 gid = int(msg[0])
                 bdef = (self.program.behaviour_table[gid]

@@ -87,8 +87,14 @@ class RtState:
 
     # Mailboxes (≙ messageq.c): one lane per actor, device and host
     # cohorts; ring slot and payload word are the (small, static) major
-    # axes — see the layout note in the module docstring.
-    buf: jnp.ndarray          # [cap, 1+W, N] int32 — word0 = behaviour gid
+    # axes — see the layout note in the module docstring. PER-COHORT
+    # word width (≙ per-type pony_msg_t sizes, genfun.c): each type's
+    # table is [cap, 1+W_c, capacity] where W_c = min(opts.msg_words,
+    # the cohort's widest behaviour) — a narrow type's million mailboxes
+    # stop paying the widest type's HBM footprint. Keys = type names;
+    # the last axis is the cohort's shard-major slot axis (like
+    # type_state columns). Spills/inject/outbox keep the global width.
+    buf: Dict[str, jnp.ndarray]  # {type: [cap, 1+W_c, capacity]} int32
     head: jnp.ndarray         # [N] int32, monotonic pop count
     tail: jnp.ndarray         # [N] int32, monotonic push count
 
@@ -176,6 +182,14 @@ class RtState:
     plan_perm: jnp.ndarray    # [P*E] int32 stable-sort permutation
     plan_bounds: jnp.ndarray  # [P*(n_local+1)] int32 segment bounds
 
+    # Mesh-wide world facts from the previous tick's packed vote, stored
+    # shard-uniform: bit0 = any pressured, bit1 = any muted, bit2 = any
+    # route-spill entries. They gate the per-tick all_gathers/psums the
+    # backpressure machinery needs only when those states exist — a quiet
+    # mesh tick runs collective-free except routing + one vote
+    # (≙ idle costing ~nothing, the fork's README.md:8-10 thesis).
+    world_bits: jnp.ndarray   # [P] int32
+
     # Per-type state columns: {type_name: {field: [cohort.capacity] array}}
     # (leading axis shard-major; see Cohort.slot_to_col).
     type_state: Dict[str, Dict[str, jnp.ndarray]]
@@ -205,7 +219,9 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         type_state[cohort.atype.__name__] = fields
 
     return RtState(
-        buf=jnp.zeros((c, w1, n), i32),
+        buf={cohort.atype.__name__:
+             jnp.zeros((c, 1 + cohort.msg_words, cohort.capacity), i32)
+             for cohort in program.cohorts},
         head=jnp.zeros((n,), i32),
         tail=jnp.zeros((n,), i32),
         alive=jnp.zeros((n,), jnp.bool_),
@@ -248,6 +264,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         plan_key=jnp.full((p * n_entries,), -1, i32),
         plan_perm=jnp.zeros((p * n_entries,), i32),
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),
+        world_bits=jnp.zeros((p,), i32),
         type_state=type_state,
     )
 

@@ -1,0 +1,72 @@
+"""The dispatch-heterogeneity cliff: tick cost vs behaviours-per-type.
+
+Planar dispatch evaluates every behaviour of a cohort per batch slot
+(engine.py scan_body) where the reference's generated switch costs one
+indirect jump (genfun.c) — this measures the resulting curve and A/Bs
+the branch-gating countermeasure (RuntimeOptions.dispatch_gating: skip
+a behaviour's planar evaluation under a scalar cond when no lane's
+current message selects it).
+
+Usage: python profiling/_hetero.py [actors] [--platform cpu|tpu]
+Writes one line per (B, traffic, gating) config; CPU numbers give the
+curve SHAPE (the go/no-go signal); on-chip numbers decide promotion.
+"""
+
+import os
+import sys
+import time
+
+if "--platform" in sys.argv:
+    plat = sys.argv[sys.argv.index("--platform") + 1]
+else:
+    plat = "cpu"
+if plat == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax                                            # noqa: E402
+import jax.numpy as jnp                               # noqa: E402
+
+from ponyc_tpu import RuntimeOptions                  # noqa: E402
+from ponyc_tpu.models import mixed                    # noqa: E402
+
+
+def measure(actors: int, n_beh: int, hot, gating: bool,
+            ticks: int = 64, fuse: int = 16, work: int = 0):
+    opts = RuntimeOptions(mailbox_cap=4, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=256, inject_slots=8,
+                          dispatch_gating=gating)
+    rt, ids, wt = mixed.build(actors, n_beh, opts, hot=hot, work=work)
+    mixed.seed_all(rt, ids, wt, hops=1 << 30)
+    K = fuse
+    limit = jnp.int32(K)
+    inj = rt._empty_inject
+    state = rt.state
+    state, aux, _ = rt._multi(state, *inj, limit)      # jit + warm
+    jax.block_until_ready(aux)
+    windows = max(1, ticks // K)
+    t0 = time.perf_counter()
+    for _ in range(windows):
+        state, aux, _ = rt._multi(state, *inj, limit)
+    jax.block_until_ready(aux)
+    dt = (time.perf_counter() - t0) / (windows * K)
+    rt.state = state
+    processed = int(rt.counter("n_processed"))
+    return 1e3 * dt, processed
+
+
+if __name__ == "__main__":
+    actors = int(sys.argv[1]) if len(sys.argv) > 1 and \
+        not sys.argv[1].startswith("-") else 1 << 13
+    print(f"platform={jax.default_backend()} actors={actors}", flush=True)
+    for work in (0, 64):
+        for gating in (False, True):
+            for n_beh in (1, 2, 4, 8, 16):
+                for hot in (None, 1):
+                    label = "one-hot" if hot == 1 else "all-hot"
+                    ms, proc = measure(actors, n_beh, hot, gating,
+                                       work=work)
+                    print(f"work={work:3d} B={n_beh:2d} {label:7s} "
+                          f"gating={int(gating)} tick_ms={ms:8.3f} "
+                          f"processed={proc}", flush=True)

@@ -1,4 +1,10 @@
-"""Round-2 profile: where does the ubench tick go? (component timings)"""
+"""Round-2 profile: where does the ubench tick go? (component timings)
+
+HISTORICAL — written against the round-2 actor-major layout and the old
+_cohort_dispatch/buf APIs (superseded twice: planar relayout in round 3,
+per-cohort mailbox widths in round 5). Kept as the record of the §3
+PROFILE.md measurements; use _profile8.py/_profile9.py for current
+component timings."""
 import sys
 import time
 

@@ -53,6 +53,10 @@ rt.state = s2
 
 # --- dispatch only (planar)
 ch = rt.program.device_cohorts[0]
+# Per-cohort mailbox widths (delivery rebuilds each table at its own
+# width; ubench has the one Pinger cohort).
+LAYOUT = tuple((c.atype.__name__, c.local_start, c.local_stop,
+                1 + c.msg_words) for c in rt.program.cohorts)
 disp = engine._cohort_dispatch(ch, opts, opts.noyield, rt.program)
 idsj = jnp.arange(N, dtype=jnp.int32)
 
@@ -60,7 +64,8 @@ idsj = jnp.arange(N, dtype=jnp.int32)
 def dispatch_only(state):
     occ = state.tail - state.head
     runnable = state.alive & ~state.muted
-    return disp(state.type_state[ch.atype.__name__], state.buf,
+    return disp(state.type_state[ch.atype.__name__],
+                state.buf[ch.atype.__name__],
                 state.head, occ, runnable, idsj, {})
 
 
@@ -80,7 +85,7 @@ def deliver_cached(state, tgt, sender, words):
         state.buf, state.head, state.tail, state.alive, e,
         n_local=N, mailbox_cap=CAP, spill_cap=1024,
         overload_occ=opts.overload_occ, shard_base=jnp.int32(0),
-        mute_slots=opts.mute_slots,
+        cohort_layout=LAYOUT, mute_slots=opts.mute_slots,
         plan=(state.plan_key, state.plan_perm, state.plan_bounds))
 
 
@@ -90,7 +95,7 @@ def deliver_nocache(state, tgt, sender, words):
         state.buf, state.head, state.tail, state.alive, e,
         n_local=N, mailbox_cap=CAP, spill_cap=1024,
         overload_occ=opts.overload_occ, shard_base=jnp.int32(0),
-        mute_slots=opts.mute_slots, plan=None)
+        cohort_layout=LAYOUT, mute_slots=opts.mute_slots, plan=None)
 
 
 sender = jnp.asarray(ent.sender)
@@ -134,14 +139,16 @@ def plane_rebuild(buf, head, tail):
     return jnp.stack(planes)
 
 
-timeit("plane rebuild (CAP planes)", plane_rebuild, st.buf, st.head, st.tail)
+timeit("plane rebuild (CAP planes)", plane_rebuild,
+       st.buf[ch.atype.__name__], st.head, st.tail)
 
 # --- ring take chain (dispatch input read)
 def ring_take_all(buf, head):
     return engine._ring_take(buf, head % CAP)
 
 
-timeit("_ring_take (select chain over cap)", ring_take_all, st.buf, st.head)
+timeit("_ring_take (select chain over cap)", ring_take_all,
+       st.buf[ch.atype.__name__], st.head)
 
 # --- key equality (cache validate)
 timeit("plan key compare", lambda a, b: jnp.all(a == b), key, key)

@@ -67,6 +67,8 @@ if want("step"):
 
 # 1. dispatch only
 ch = rt.program.device_cohorts[0]
+LAYOUT = tuple((c.atype.__name__, c.local_start, c.local_stop,
+                1 + c.msg_words) for c in rt.program.cohorts)
 disp = engine._cohort_dispatch(ch, opts, opts.noyield, rt.program)
 idsj = jnp.arange(N, dtype=jnp.int32)
 
@@ -74,8 +76,8 @@ idsj = jnp.arange(N, dtype=jnp.int32)
 def disp_body(s):
     occ = s.tail - s.head
     runnable = s.alive & ~s.muted
-    out = disp(s.type_state[ch.atype.__name__], s.buf, s.head, occ,
-               runnable, idsj, {})
+    out = disp(s.type_state[ch.atype.__name__], s.buf[ch.atype.__name__],
+               s.head, occ, runnable, idsj, {})
     # chain: fold outbox into head so the loop carries a dependency
     return s._replace(head=out[2])
 
@@ -86,7 +88,8 @@ if want("disp"):
 # one real outbox for delivery inputs
 occ = st.tail - st.head
 runnable = st.alive & ~st.muted
-out = jax.jit(lambda s: disp(s.type_state[ch.atype.__name__], s.buf,
+out = jax.jit(lambda s: disp(s.type_state[ch.atype.__name__],
+                             s.buf[ch.atype.__name__],
                              s.head, occ, runnable, idsj, {}))(st)
 ent = out[1]
 tgt, sender, words = (jnp.asarray(ent.tgt), jnp.asarray(ent.sender),
@@ -107,7 +110,7 @@ def deliver_body(plan):
             s.buf, s.head, s.tail, s.alive, e,
             n_local=N, mailbox_cap=CAP, spill_cap=1024,
             overload_occ=opts.overload_occ, shard_base=jnp.int32(0),
-            mute_slots=opts.mute_slots,
+            cohort_layout=LAYOUT, mute_slots=opts.mute_slots,
             plan=(s.plan_key, s.plan_perm, s.plan_bounds) if use_plan
             else None)
         return s._replace(buf=res.buf, plan_key=res.plan_key,
@@ -157,10 +160,11 @@ def plane_rebuild(buf, head, tail):
 
 if want("sub"):
     timeit_loop("plane rebuild (CAP planes)",
-                lambda b: plane_rebuild(b, st.head, st.tail), st.buf)
+                lambda b: plane_rebuild(b, st.head, st.tail),
+                st.buf[ch.atype.__name__])
     timeit_loop("_ring_take (cap select chain)",
                 lambda b: b.at[0].set(engine._ring_take(b, st.head % CAP)),
-                st.buf)
+                st.buf[ch.atype.__name__])
     timeit_loop("1-D lane gather wds[0][src]",
                 lambda s: wds[0][jnp.minimum(seg + s[0] * 0, EF - 1)] + s,
                 jnp.zeros((N,), jnp.int32))

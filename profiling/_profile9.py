@@ -51,8 +51,9 @@ def make_deliver_patch(skip_rebuild=False, skip_gather=False):
     from ponyc_tpu.ops.segment import stable_sort_by
 
     def deliver(buf, head, tail, alive, entries, *, n_local, mailbox_cap,
-                spill_cap, overload_occ, shard_base, mute_slots=4,
-                level=None, n_levels=1, plan=None):
+                spill_cap, overload_occ, shard_base, cohort_layout,
+                mute_slots=4, level=None, n_levels=1, plan=None,
+                pressured=None, cosort=False):
         n, c = n_local, mailbox_cap
         tgt, sender, words = entries
         e = tgt.shape[0]
@@ -95,15 +96,18 @@ def make_deliver_patch(skip_rebuild=False, skip_gather=False):
         if skip_rebuild:
             buf2 = buf
         else:
-            planes = []
-            for ci in range(c):
-                rel = (ci - tail) % c
-                wmask = rel < acc
-                src = jnp.minimum(seg_start + rel, e - 1)
-                planes.append(jnp.where(wmask[None, :],
-                                        jnp.take(wds, src, axis=1),
-                                        buf[ci]))
-            buf2 = jnp.stack(planes)
+            # Per-cohort tables at their own widths (delivery.py).
+            buf2 = {}
+            for cname, s0, s1, w1c in cohort_layout:
+                planes = []
+                for ci in range(c):
+                    rel = (ci - tail[s0:s1]) % c
+                    wmask = rel < acc[s0:s1]
+                    src = jnp.minimum(seg_start[s0:s1] + rel, e - 1)
+                    planes.append(jnp.where(wmask[None, :],
+                                            jnp.take(wds[:w1c], src, axis=1),
+                                            buf[cname][ci]))
+                buf2[cname] = jnp.stack(planes)
         refs, ovf = delivery.empty_mute_slots(n, mute_slots)
         return delivery.DeliveryResult(
             buf=buf2, tail=new_tail,

@@ -1,0 +1,383 @@
+"""The FULL six-cap lattice: iso/trn/ref(Mut)/val/box/tag with viewpoint
+adaptation — matrix tests naming every cap pair.
+
+≙ src/libponyc/type/cap.c:59-160 (is_cap_sub_cap), cap.c:581-711
+(cap_view_upper), type/alias.c (cap_aliasing: iso→tag, trn→box) and
+safeto.c's CAP_SEND {iso, val, tag}. The store matrix, the viewpoint
+table and the alias rule below are transcribed row-by-row from those
+functions; any edit here must cite a corresponding reference change.
+"""
+
+import pytest
+
+from ponyc_tpu import (Box, I32, Iso, Mut, Ref, Runtime, RuntimeOptions,
+                       Tag, Trn, Val, actor, behaviour)
+from ponyc_tpu.hostmem import CapabilityError, HandleRef, HostHeap
+from ponyc_tpu.ops import pack
+
+CAPS = ("iso", "trn", "ref", "val", "box", "tag")
+
+OPTS = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=2,
+                      inject_slots=8)
+
+
+# ---------------- the store lattice, every pair ----------------
+
+# (src stored into dst) — True rows follow is_cap_sub_cap with unique
+# sources consumed (iso^/trn^): cap.c:80-97 (iso sub of all),
+# 99-113 (trn sub of all but iso), 115-124 (ref <: ref, box),
+# 126-136 (val <: val, box), 138-146 (box <: box), super tag always
+# true (cap.c:73-74).
+EXPECTED_STORE = {
+    ("iso", "iso"): True, ("iso", "trn"): True, ("iso", "ref"): True,
+    ("iso", "val"): True, ("iso", "box"): True, ("iso", "tag"): True,
+    ("trn", "iso"): False, ("trn", "trn"): True, ("trn", "ref"): True,
+    ("trn", "val"): True, ("trn", "box"): True, ("trn", "tag"): True,
+    ("ref", "iso"): False, ("ref", "trn"): False, ("ref", "ref"): True,
+    ("ref", "val"): False, ("ref", "box"): True, ("ref", "tag"): True,
+    ("val", "iso"): False, ("val", "trn"): False, ("val", "ref"): False,
+    ("val", "val"): True, ("val", "box"): True, ("val", "tag"): True,
+    ("box", "iso"): False, ("box", "trn"): False, ("box", "ref"): False,
+    ("box", "val"): False, ("box", "box"): True, ("box", "tag"): True,
+    ("tag", "iso"): False, ("tag", "trn"): False, ("tag", "ref"): False,
+    ("tag", "val"): False, ("tag", "box"): False, ("tag", "tag"): True,
+}
+
+
+@pytest.mark.parametrize("src", CAPS)
+@pytest.mark.parametrize("dst", CAPS)
+def test_store_lattice_pair(src, dst):
+    assert pack.cap_store_ok(src, dst) is EXPECTED_STORE[(src, dst)], \
+        f"{src} stored into {dst}"
+
+
+def test_store_lattice_gradual():
+    for m in CAPS:
+        assert pack.cap_store_ok(None, m)
+        assert pack.cap_store_ok(m, None)
+
+
+# ---------------- viewpoint adaptation, every pair ----------------
+
+# origin▷field — transcribed from cap_view_upper (cap.c:581-711):
+# tag origin sees nothing (588-596); field tag is always tag (600-602);
+# iso▷: iso→iso, val→val, else tag (604-624); trn▷: iso→iso, trn→trn,
+# val→val, else box (626-651); ref▷T = T (653-654); val▷T = val
+# (656-672); box▷: iso→tag, val→val, else box (674-699).
+EXPECTED_VIEW = {
+    "iso": {"iso": "iso", "trn": "tag", "ref": "tag", "val": "val",
+            "box": "tag", "tag": "tag"},
+    "trn": {"iso": "iso", "trn": "trn", "ref": "box", "val": "val",
+            "box": "box", "tag": "tag"},
+    "ref": {"iso": "iso", "trn": "trn", "ref": "ref", "val": "val",
+            "box": "box", "tag": "tag"},
+    "val": {"iso": "val", "trn": "val", "ref": "val", "val": "val",
+            "box": "val", "tag": "tag"},
+    "box": {"iso": "tag", "trn": "box", "ref": "box", "val": "val",
+            "box": "box", "tag": "tag"},
+    "tag": {c: None for c in CAPS},
+}
+
+
+@pytest.mark.parametrize("origin", CAPS)
+@pytest.mark.parametrize("field", CAPS)
+def test_viewpoint_pair(origin, field):
+    assert pack.viewpoint(origin, field) == EXPECTED_VIEW[origin][field], \
+        f"{origin}▷{field}"
+
+
+def test_alias_rule():
+    # cap_aliasing (alias.c): iso aliases as tag, trn as box, rest self.
+    assert pack.cap_alias("iso") == "tag"
+    assert pack.cap_alias("trn") == "box"
+    for m in ("ref", "val", "box", "tag"):
+        assert pack.cap_alias(m) == m
+
+
+def test_sendable_set_is_cap_send():
+    # TK_CAP_SEND {iso, val, tag} (cap.c:90).
+    assert {m for m in CAPS if pack.cap_sendable(m)} == \
+        {"iso", "val", "tag"}
+
+
+# ---------------- sendability at the behaviour boundary ----------------
+
+@pytest.mark.parametrize("capspec", [Trn, Mut, Box])
+def test_local_caps_are_not_sendable_parameters(capspec):
+    with pytest.raises(TypeError, match="not sendable"):
+        @actor
+        class Bad:
+            x: I32
+
+            @behaviour
+            def take(self, st, h: capspec):
+                return st
+
+
+def test_local_caps_are_legal_fields():
+    @actor
+    class LocalState:
+        scratch: Trn
+        view: Box
+        cell: Mut
+        n: I32
+
+        @behaviour
+        def tick(self, st):
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(OPTS)
+    rt.declare(LocalState, 1).start()
+    a = rt.spawn(LocalState)
+    rt.send(a, LocalState.tick)
+    rt.run(max_steps=4)
+    assert int(rt.cohort_state(LocalState)["n"][0]) == 1
+
+
+# ---------------- trace-time trn discipline ----------------
+
+def _run_one(cls, beh, *args):
+    rt = Runtime(OPTS)
+    rt.declare(cls, 1).start()
+    a = rt.spawn(cls)
+    rt.send(a, beh, *args)
+    rt.run(max_steps=4)
+    return rt
+
+
+def test_trn_keep_in_place_is_legal():
+    @actor
+    class Keep:
+        t: Trn
+
+        @behaviour
+        def hold(self, st):
+            return st                      # keeping the trn field: free
+
+    _run_one(Keep, Keep.hold)
+
+
+def test_trn_keep_plus_box_alias_is_legal():
+    # Pony's trn+box sharing: one writer, read views alias freely.
+    @actor
+    class Share:
+        t: Trn
+        v: Box
+
+        @behaviour
+        def share(self, st):
+            return {**st, "v": st["t"]}
+
+    _run_one(Share, Share.share)
+
+
+def test_trn_consumed_into_second_trn_field_requires_clearing():
+    @actor
+    class MoveKeep:
+        t: Trn
+        u: Trn
+
+        @behaviour
+        def leak(self, st):
+            # moves t into u but ALSO keeps t — use-after-consume.
+            return {**st, "u": st["t"]}
+
+    with pytest.raises(TypeError, match="retains it|use-after-consume"):
+        _run_one(MoveKeep, MoveKeep.leak)
+
+
+def test_trn_move_with_clear_is_legal():
+    @actor
+    class MoveClear:
+        t: Trn
+        u: Trn
+
+        @behaviour
+        def move(self, st):
+            return {**st, "u": st["t"], "t": -1}
+
+    _run_one(MoveClear, MoveClear.move)
+
+
+def test_trn_double_consume_rejected():
+    @actor
+    class DoubleMove:
+        t: Trn
+        u: Trn
+        w: Mut
+
+        @behaviour
+        def boom(self, st):
+            return {**st, "u": st["t"], "w": st["t"], "t": -1}
+
+    with pytest.raises(TypeError, match="write-unique|BOTH fields"):
+        _run_one(DoubleMove, DoubleMove.boom)
+
+
+def test_val_cannot_enter_trn_field():
+    @actor
+    class Freeze:
+        t: Trn
+
+        @behaviour
+        def put(self, st, h: Val):
+            return {**st, "t": h}
+
+    with pytest.raises(TypeError, match="cannot grant"):
+        _run_one(Freeze, Freeze.put, 7)
+
+
+def test_iso_arg_may_land_in_any_writable_field():
+    @actor
+    class Sink:
+        t: Trn
+        m: Mut
+
+        @behaviour
+        def take_t(self, st, h: Iso):
+            return {**st, "t": h}
+
+    _run_one(Sink, Sink.take_t, 7)
+
+
+# ---------------- HostHeap dynamic rules ----------------
+
+def test_heap_write_rights_matrix():
+    hh = HostHeap()
+    for m in CAPS:
+        h = hh.box(["x"], mode=m)
+        if m in ("iso", "trn", "ref"):
+            hh.poke(h, ["y"])
+            assert hh.peek(h) == ["y"]
+        else:
+            with pytest.raises(CapabilityError):
+                hh.poke(h, ["y"])
+
+
+def test_heap_read_rights():
+    hh = HostHeap()
+    for m in CAPS:
+        h = hh.box("obj", mode=m)
+        if m == "tag":
+            with pytest.raises(CapabilityError):
+                hh.peek(h)
+        else:
+            assert hh.peek(h) == "obj"
+
+
+def test_heap_unbox_rights():
+    hh = HostHeap()
+    for m in CAPS:
+        h = hh.box("obj", mode=m)
+        if m in ("iso", "trn"):
+            assert hh.unbox(h) == "obj"
+        else:
+            with pytest.raises(CapabilityError):
+                hh.unbox(h)
+
+
+def test_heap_view_legality_follows_alias_rule():
+    hh = HostHeap()
+    for src in CAPS:
+        aliased = pack.cap_alias(src)
+        for dst in CAPS:
+            h = hh.box("obj", mode=src)
+            if pack.cap_store_ok(aliased, dst):
+                v = hh.view(h, dst)
+                assert hh.mode(v) == dst
+            else:
+                with pytest.raises(CapabilityError):
+                    hh.view(h, dst)
+
+
+def test_heap_box_view_of_trn_reads_while_owner_writes():
+    hh = HostHeap()
+    t = hh.box({"n": 1}, mode="trn")
+    v = hh.view(t, "box")
+    assert hh.peek(v) == {"n": 1}
+    hh.poke(t, {"n": 2})
+    assert hh.peek(v) == {"n": 2}          # view tracks the one writer
+    with pytest.raises(CapabilityError):
+        hh.poke(v, {})                     # box never writes
+
+
+def test_heap_viewpoint_field_read_composition():
+    hh = HostHeap()
+    inner_iso = hh.box("secret", mode="iso")
+    inner_ref = hh.box(["mutable"], mode="ref")
+    outer = hh.box({"i": HandleRef(inner_iso), "r": HandleRef(inner_ref),
+                    "plain": 42}, mode="trn")
+    # trn▷ref = box: readable view, no write rights.
+    vr = hh.peek_field(outer, "r")
+    assert hh.mode(vr) == "box" and hh.peek(vr) == ["mutable"]
+    # trn▷iso = iso, but a field READ binds alias(iso) = tag — reading
+    # can never mint a second owner of a unique (alias.c).
+    vi0 = hh.peek_field(outer, "i")
+    assert hh.mode(vi0) == "tag"
+    # box origin: box▷iso = tag — identity only.
+    bouter = hh.view(outer, "box")
+    vi = hh.peek_field(bouter, "i")
+    assert hh.mode(vi) == "tag"
+    with pytest.raises(CapabilityError):
+        hh.peek(vi)
+    # plain values just read (origin must merely be readable).
+    assert hh.peek_field(outer, "plain") == 42
+    # tag origin reads nothing.
+    touter = hh.view(outer, "tag")
+    with pytest.raises(CapabilityError):
+        hh.peek_field(touter, "plain")
+
+
+def test_heap_plain_int_field_is_data_even_if_it_collides_with_a_handle():
+    hh = HostHeap()
+    hh.box([9, 9, 9], mode="ref")          # issues handle 1
+    o = hh.box({"count": 1}, mode="ref")   # plain int 1, NOT a reference
+    assert hh.peek_field(o, "count") == 1  # data, not a view of handle 1
+
+
+def test_heap_poke_through_writable_view_updates_all_aliases():
+    hh = HostHeap()
+    r = hh.box({"x": 1}, mode="ref")
+    v = hh.view(r, "ref")                  # alias(ref)=ref: writable view
+    b = hh.view(r, "box")
+    hh.poke(v, {"x": 99})
+    assert hh.peek(r) == {"x": 99}         # root sees the write
+    assert hh.peek(b) == {"x": 99}         # sibling view sees it too
+
+
+def test_heap_field_read_never_mints_a_second_owner():
+    """Regression (round-5 review): iso▷iso / trn▷trn field reads must
+    come back as aliases (tag / box), or two owners could each unbox —
+    extracting ownership of one object twice."""
+    hh = HostHeap()
+    inner = hh.box([1, 2, 3], mode="iso")
+    outer = hh.box({"x": HandleRef(inner)}, mode="iso")
+    v = hh.peek_field(outer, "x")
+    assert hh.mode(v) == "tag"             # alias(iso▷iso) = alias(iso)
+    with pytest.raises(CapabilityError):
+        hh.unbox(v)                        # no second ownership take
+    hh2 = HostHeap()
+    t_in = hh2.box({"n": 1}, mode="trn")
+    t_out = hh2.box({"y": HandleRef(t_in)}, mode="trn")
+    w = hh2.peek_field(t_out, "y")
+    assert hh2.mode(w) == "box"            # alias(trn▷trn) = alias(trn)
+    with pytest.raises(CapabilityError):
+        hh2.poke(w, {})                    # no second writer
+
+
+def test_heap_freeze_and_recover():
+    hh = HostHeap()
+    t = hh.box([1], mode="trn")
+    assert hh.mode(hh.freeze(t)) == "val"      # trn→val: Pony's freeze
+    r = hh.box([2], mode="ref")
+    assert hh.mode(hh.recover_iso(r)) == "iso"  # unaliased ref lifts
+    r2 = hh.box([3], mode="ref")
+    _ = hh.view(r2, "box")
+    with pytest.raises(CapabilityError):
+        hh.recover_iso(r2)                     # aliased: no lift
+    v = hh.box([4], mode="val")
+    with pytest.raises(CapabilityError):
+        hh.recover_iso(v)                      # shared never unique again
+    b = hh.view(hh.box([5], mode="ref"), "box")
+    with pytest.raises(CapabilityError):
+        hh.freeze(b)                           # borrowed view: no freeze

@@ -1,0 +1,92 @@
+"""Per-cohort mailbox word widths (≙ per-type pony_msg_t sizes —
+src/libponyc/codegen/genfun.c packs exactly each behaviour's params;
+no type pays another type's message width).
+
+RuntimeOptions.msg_words stays the program-wide declared max (outbox/
+spill/inject width); each cohort's mailbox TABLE narrows to its own
+widest behaviour — the dominant HBM array (cap × w1 × N) stops paying
+the widest type's footprint for narrow types.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ponyc_tpu import (F32, I32, Ref, Runtime, RuntimeOptions, VecF32,
+                       actor, behaviour)
+
+OPTS = RuntimeOptions(mailbox_cap=4, batch=2, max_sends=1, msg_words=7,
+                      inject_slots=8)
+
+
+@actor
+class Wide:
+    acc: F32
+    hits: I32
+
+    @behaviour
+    def take(self, st, v: VecF32[6], scale: F32):
+        return {"acc": st["acc"] + scale * jnp.sum(v, axis=0),
+                "hits": st["hits"] + 1}
+
+
+@actor
+class Narrow:
+    out: Ref["Wide"]
+    fired: I32
+    MAX_SENDS = 1
+
+    @behaviour
+    def fire(self, st):                      # zero payload words
+        self.send(st["out"], Wide.take,
+                  jnp.arange(6, dtype=jnp.float32), 2.0)
+        return {**st, "fired": st["fired"] + 1}
+
+
+def _build():
+    rt = Runtime(OPTS)
+    rt.declare(Wide, 4).declare(Narrow, 4).start()
+    return rt
+
+
+def test_cohort_tables_have_their_own_width():
+    rt = _build()
+    # Wide.take needs 6 (vector) + 1 (scale) = 7 words; Narrow.fire 0.
+    assert rt.state.buf["Wide"].shape[1] == 1 + 7
+    assert rt.state.buf["Narrow"].shape[1] == 1      # gid word only
+    # Spills keep the global width (messages for ANY target park there).
+    assert rt.state.dspill_words.shape[0] == 1 + OPTS.msg_words
+
+
+def test_cross_width_messaging_roundtrip():
+    rt = _build()
+    w = rt.spawn(Wide)
+    n = rt.spawn(Narrow, out=w)
+    for _ in range(3):
+        rt.send(n, Narrow.fire)
+    rt.run(max_steps=16)
+    ws = rt.cohort_state(Wide)
+    col = rt.program.by_type_name("Wide").gid_to_col(w)
+    assert int(ws["hits"][col]) == 3
+    # sum(0..5) * 2.0 * 3 fires = 90.
+    assert float(ws["acc"][col]) == 90.0
+    ns = rt.cohort_state(Narrow)
+    ncol = rt.program.by_type_name("Narrow").gid_to_col(n)
+    assert int(ns["fired"][ncol]) == 3
+
+
+def test_host_send_into_wide_cohort_packs_full_width():
+    rt = _build()
+    w = rt.spawn(Wide)
+    rt.send(w, Wide.take, np.arange(6, dtype=np.float32), 0.5)
+    rt.run(max_steps=8)
+    col = rt.program.by_type_name("Wide").gid_to_col(w)
+    assert float(rt.cohort_state(Wide)["acc"][col]) == 7.5
+
+
+def test_bulk_send_into_narrow_cohort():
+    rt = _build()
+    w = rt.spawn(Wide)
+    ids = [rt.spawn(Narrow, out=w) for _ in range(3)]
+    rt.bulk_send(np.asarray(ids), Narrow.fire)
+    rt.run(max_steps=16)
+    assert int(rt.cohort_state(Wide)["hits"].sum()) == 3
