@@ -89,6 +89,21 @@ def build(n_workers: int, n_behaviours: int,
     return rt, ids, wt
 
 
-def seed_all(rt: Runtime, ids, wt, hops: int, pings: int = 1):
+def seed_all(rt: Runtime, ids, wt, hops: int, pings: int = 1,
+             mix: bool = False):
+    """Default seeding puts every token on step0 → the round-robin wave
+    stays PHASE-SYNCHRONIZED (each tick all lanes carry one behaviour
+    id — the case dispatch gating collapses to O(1)). mix=True spreads
+    lanes across all B behaviours → every tick carries every id (the
+    gating worst case: nothing can be skipped)."""
+    steps = [getattr(wt, f"step{k}")
+             for k in range(len(wt.behaviour_defs))]
     for _ in range(pings):
-        rt.bulk_send(ids, wt.step0, np.full(len(ids), hops, np.int64))
+        if not mix:
+            rt.bulk_send(ids, wt.step0, np.full(len(ids), hops, np.int64))
+            continue
+        ids_a = np.asarray(ids)
+        for k, bd in enumerate(steps):
+            sel = ids_a[k::len(steps)]
+            if len(sel):
+                rt.bulk_send(sel, bd, np.full(len(sel), hops, np.int64))
