@@ -335,6 +335,16 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     spawn_meta = {t: program.by_type_name(t).atype.field_specs
                   for t, _ in spawn_sites}
     effects = {"destroy": False, "error": False, "sync_init": False}
+
+    def _zero_inits():
+        """Zero sync-init structure — shared by the fused busy path and
+        idle_fn so the lax.cond branch pytrees can never drift."""
+        return tuple(
+            (jnp.zeros((batch * n * rows,), jnp.bool_),
+             {f: jnp.zeros((batch * n * rows,),
+                           jnp.float32 if sp is pack.F32 else jnp.int32)
+              for f, sp in spawn_meta[tname].items()})
+            for tname, n in spawn_sites)
     branches = [_make_branch(b, msg_words, ms, field_dtypes,
                              cohort.atype.field_specs, spawn_sites,
                              spawn_meta, effects, rows)
@@ -343,13 +353,14 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     base = cohort.behaviours[0].global_id if nb else 0
     sd = cohort.spawn_dispatches
     fused = None
-    if opts.pallas_fused and nb >= 1 and not cohort.spawns:
+    if opts.pallas_fused and nb >= 1:
         from ..ops import fused_dispatch as fd
         from ..ops import mailbox_kernel as mk
         if rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0:
             # Probe-trace every branch so `effects` is discovered BEFORE
-            # the path decision (the fused kernel hosts destroy/error as
-            # lane planes but cannot host sync-construction packaging).
+            # the path decision (the fused kernel hosts destroy/error/
+            # spawn claims as lane planes but cannot host
+            # sync-construction packaging).
             for br in branches:
                 jax.eval_shape(
                     br,
@@ -357,7 +368,9 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                      for f in cohort.atype.field_specs},
                     jax.ShapeDtypeStruct((cohort.msg_words, rows),
                                          jnp.int32),
-                    jax.ShapeDtypeStruct((rows,), jnp.int32), {})
+                    jax.ShapeDtypeStruct((rows,), jnp.int32),
+                    {t: jax.ShapeDtypeStruct((n, rows), jnp.int32)
+                     for t, n in spawn_sites})
             if fd.eligible(cohort, effects, opts):
                 fnames = tuple(cohort.atype.field_specs.keys())
                 fused = (fd.build_fused_dispatch(
@@ -366,7 +379,9 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     field_specs=cohort.atype.field_specs, batch=batch,
                     cap=cap, msg_words=msg_words,
                     msg_words_in=cohort.msg_words, ms=ms, rows=rows,
-                    noyield=noyield, interpret=mk.interpret_mode()),
+                    noyield=noyield, interpret=mk.interpret_mode(),
+                    spawn_sites=spawn_sites, spawn_meta=spawn_meta,
+                    spawn_dispatches=sd),
                     fnames)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
@@ -507,16 +522,23 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             if fused is not None:
                 kernel_fn, fnames = fused
                 fields = tuple(type_state_rows[f] for f in fnames)
+                resv_in = tuple(resv[t].reshape(sd * n, rows)
+                                for t, n in spawn_sites)
                 (nf_out, out_tgt, out_words, new_head, nproc_l, nbad_l,
-                 ef_l, ec_l, ds_l, erf_l, erc_l, erl_l) = kernel_fn(
-                    fields, buf_rows, head_rows, n_run, ids)
+                 ef_l, ec_l, ds_l, erf_l, erc_l, erl_l, claims_out,
+                 sf_l) = kernel_fn(
+                    fields, buf_rows, head_rows, n_run, ids, resv_in)
                 stf = dict(zip(fnames, nf_out))
                 any_exit = jnp.any(ef_l)
                 code = ec_l[jnp.argmax(ef_l)]
+                # Claims flatten (k, site, lane) exactly like the XLA
+                # scan's stack; inits are the zero structure (the fused
+                # path never hosts sync-construction — eligibility).
+                claims_t = tuple(c.reshape(-1) for c in claims_out)
                 return (stf, out_tgt, out_words, new_head, any_exit,
                         code, jnp.sum(nproc_l), jnp.sum(nbad_l),
-                        tuple(), tuple(), jnp.bool_(False), ds_l, erf_l,
-                        erc_l, erl_l)
+                        claims_t, _zero_inits(), jnp.any(sf_l), ds_l,
+                        erf_l, erc_l, erl_l)
             if opts.pallas:          # gate BEFORE importing pallas/mosaic
                 from ..ops import mailbox_kernel as mk
             if opts.pallas and (rows <= mk.LANE_BLOCK
@@ -566,13 +588,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     jnp.int32(0), jnp.int32(0),
                     tuple(jnp.full((batch * n * rows,), -1, jnp.int32)
                           for _, n in spawn_sites),
-                    tuple((jnp.zeros((batch * n * rows,), jnp.bool_),
-                           {f: jnp.zeros(
-                               (batch * n * rows,),
-                               jnp.float32 if sp is pack.F32
-                               else jnp.int32)
-                            for f, sp in spawn_meta[tname].items()})
-                          for tname, n in spawn_sites),
+                    _zero_inits(),
                     jnp.bool_(False),
                     jnp.zeros((rows,), jnp.bool_),
                     jnp.zeros((rows,), jnp.bool_),
