@@ -174,6 +174,27 @@ def cmd_verify(argv) -> int:
     return 1 if bad else 0
 
 
+def cmd_trace(argv) -> int:
+    """Convert analysis CSVs to a Chrome-trace/Perfetto JSON (≙ the
+    dtrace/systemtap timeline scripts, examples/dtrace/telemetry.d):
+    ponyc_tpu trace <analytics.csv> [-o out.trace.json]."""
+    out = "trace.json"
+    if "-o" in argv:
+        i = argv.index("-o")
+        if i + 1 >= len(argv):
+            print("ponyc_tpu trace: -o needs a path", file=sys.stderr)
+            return 2
+        out = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    if not argv:
+        print("ponyc_tpu trace: missing <analytics.csv> "
+              "(RuntimeOptions.analysis_path)", file=sys.stderr)
+        return 2
+    from .analysis import chrome_trace
+    print(chrome_trace(argv[0], out))
+    return 0
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -188,7 +209,7 @@ def cmd_version(_argv) -> int:
 
 
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
-            "doc": cmd_doc, "verify": cmd_verify,
+            "doc": cmd_doc, "verify": cmd_verify, "trace": cmd_trace,
             "version": cmd_version}
 
 

@@ -238,3 +238,68 @@ def attach(rt) -> Analysis:
     if a.level >= 1:
         a.install_signal_dump()
     return a
+
+
+def chrome_trace(csv_path: str, out_path: str,
+                 events_path: Optional[str] = None) -> str:
+    """Convert the analysis CSVs into a Chrome-trace / Perfetto JSON.
+
+    ≙ the reference's DTrace/SystemTap scripts turning USDT probes into
+    a timeline (examples/dtrace/telemetry.d — SURVEY §5's third tracing
+    mechanism): the step-window CSV becomes counter tracks (queued
+    messages, deepest mailbox, muted/overloaded actors, throughput per
+    window) and the level-3 event CSV becomes instant events
+    (MUTE/UNMUTE/OVERLOAD/SPAWN/DESTROY/ERROR, one thread lane per
+    class) — load the output in chrome://tracing or ui.perfetto.dev.
+    `events_path` defaults to `<csv_path>.events.csv` when that file
+    exists."""
+    import csv as _csv
+    import json
+    import os
+
+    pid = 1
+    out = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "ponyc_tpu runtime"}},
+        {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+         "args": {"name": "step windows"}},
+    ]
+    with open(csv_path) as f:
+        for row in _csv.DictReader(f):
+            ts = float(row["time_ms"]) * 1e3          # µs
+            for track, cols in (
+                    ("queue", {"queued": "occ_sum",
+                               "deepest": "occ_max"}),
+                    ("actors", {"muted": "muted_now",
+                                "overloaded": "overloaded_now"}),
+                    ("window throughput", {"processed": "processed",
+                                           "delivered": "delivered"}),
+                    ("anomalies", {"rejected": "rejected",
+                                   "badmsg": "badmsg",
+                                   "deadletter": "deadletter"})):
+                out.append({"ph": "C", "pid": pid, "ts": ts,
+                            "name": track,
+                            "args": {k: int(row[c])
+                                     for k, c in cols.items()}})
+    if events_path is None:
+        cand = csv_path + ".events.csv"
+        events_path = cand if os.path.exists(cand) else None
+    if events_path is not None:
+        tids = {}
+        with open(events_path) as f:
+            for row in _csv.DictReader(f):
+                name = row["event"]
+                tid = tids.setdefault(name, len(tids) + 1)
+                out.append({"ph": "i", "pid": pid, "tid": tid, "s": "t",
+                            "ts": float(row["time_ms"]) * 1e3,
+                            "name": f"{name} a{row['actor']}",
+                            "args": {"actor": int(row["actor"]),
+                                     "step": int(row["step"])}})
+        for name, tid in tids.items():
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name",
+                        "args": {"name": f"events:{name}"}})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": out,
+                   "displayTimeUnit": "ms"}, f)
+    return out_path

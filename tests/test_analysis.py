@@ -191,3 +191,62 @@ def test_level3_ring_overflow_counts_drops(tmp_path):
     rows = open(path + ".events.csv").read().strip().splitlines()
     assert len(rows) > 1, "events must be recorded"
     assert int(rt.state.ev_dropped[0]) > 0, "tiny ring must count drops"
+
+
+def test_chrome_trace_export(tmp_path):
+    """chrome_trace (≙ the dtrace/systemtap timeline scripts,
+    examples/dtrace/telemetry.d): CSVs → Chrome-trace JSON with counter
+    tracks per window and instant events per level-3 transition."""
+    import json
+
+    from ponyc_tpu import I32, Ref, actor, behaviour
+
+    @actor
+    class TBoss:
+        SPAWNS = {"TKid": 1}
+        made: I32
+
+        @behaviour
+        def make(self, st, v: I32):
+            self.spawn(TKid.init, v)
+            return {**st, "made": st["made"] + 1}
+
+    @actor
+    class TKid:
+        x: I32
+
+        @behaviour
+        def init(self, st, v: I32):
+            self.destroy(when=v == 1)
+            return {**st, "x": v}
+
+    path = str(tmp_path / "an.csv")
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=64, inject_slots=8,
+                          analysis=3, analysis_path=path)
+    rt = Runtime(opts).declare(TBoss, 1).declare(TKid, 8).start()
+    boss = rt.spawn(TBoss)
+    for v in (0, 1, 2):
+        rt.send(boss, TBoss.make, v)
+    rt.run()
+    rt.stop()
+    out = str(tmp_path / "trace.json")
+    analysis.chrome_trace(path, out)
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert counters, "no counter tracks"
+    names = {e["name"] for e in counters}
+    assert {"queue", "actors", "window throughput"} <= names
+    total_processed = sum(e["args"].get("processed", 0) for e in counters
+                          if e["name"] == "window throughput")
+    assert total_processed == 6          # 3 makes + 3 ctor inits
+    # Device-side SPAWN/DESTROY transitions land as instant events.
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert any(e["name"].startswith("SPAWN") for e in instants)
+    assert any(e["name"].startswith("DESTROY") for e in instants)
+    # CLI path: same conversion through `python -m ponyc_tpu trace`.
+    from ponyc_tpu.__main__ import main as cli_main
+    out2 = str(tmp_path / "t2.json")
+    assert cli_main(["trace", path, "-o", out2]) == 0
+    assert json.load(open(out2))["traceEvents"]
