@@ -24,6 +24,7 @@ automatically when opts.analysis >= 1 and nothing is attached yet).
 
 from __future__ import annotations
 
+import os
 import queue
 import signal
 import sys
@@ -36,8 +37,29 @@ import numpy as np
 CSV_COLUMNS = [
     "time_ms", "step", "processed", "delivered", "rejected", "badmsg",
     "deadletter", "mutes", "occ_sum", "occ_max", "muted_now",
-    "overloaded_now", "host_processed", "inject_queue",
+    "overloaded_now", "host_processed", "inject_queue", "fast_queue",
+    "rss_kb", "cpu_ms",
 ]
+
+
+def _host_usage():
+    """Current host RSS (KB) + cumulative CPU time (ms) of this process
+    (≙ ponyint_update_memory_usage, sched/cpu.c — the reference samples
+    /proc RSS for analysis; we add CPU time since the host loop IS a
+    scheduler here)."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_ms = round((ru.ru_utime + ru.ru_stime) * 1e3, 1)
+    try:
+        with open("/proc/self/statm") as f:
+            rss_kb = int(f.read().split()[1]) * (
+                os.sysconf("SC_PAGE_SIZE") // 1024)
+    except OSError:
+        # Non-Linux fallback: ru_maxrss is the HIGH-WATER mark, and its
+        # unit is bytes on macOS vs KB on Linux/BSD.
+        rss_kb = int(ru.ru_maxrss // 1024) if sys.platform == "darwin" \
+            else int(ru.ru_maxrss)
+    return rss_kb, cpu_ms
 
 # Level-3 per-event lane (≙ analysis.h:16-31 event enum; the device
 # records transition events in a bounded ring, engine.py §5b).
@@ -85,7 +107,9 @@ class Analysis:
             self._delta("host_processed",
                         self.rt.totals.get("host_processed", 0)),
             len(self.rt._inject_q),
+            len(self.rt._host_fast_q),
         ]
+        row.extend(_host_usage())
         self._rows.put(row)
 
     def _delta(self, key, cur) -> int:
@@ -153,7 +177,10 @@ class Analysis:
                      "n_badmsg", "n_deadletter", "n_mutes"):
             lines.append(f"{name}={rt.counter(name)}")
         lines.append(f"host_processed={rt.totals.get('host_processed', 0)} "
-                     f"inject_queue={len(rt._inject_q)}")
+                     f"inject_queue={len(rt._inject_q)} "
+                     f"fast_queue={len(rt._host_fast_q)}")
+        rss_kb, cpu_ms = _host_usage()
+        lines.append(f"host_rss_kb={rss_kb} host_cpu_ms={cpu_ms}")
         if self.level >= 3 and rt.state is not None:
             lines.append(
                 f"events_pending={int(np.asarray(rt.state.ev_count).sum())} "

@@ -159,6 +159,13 @@ class Bridge:
             self._noisy_given -= 1
         return n
 
+    def wait(self, timeout_s: float) -> bool:
+        """Block until an asio event is queued or the timeout passes —
+        the run loop calls this instead of backoff-sleeping when the
+        only pending work is external I/O (≙ a suspended scheduler
+        woken by the ASIO thread, scheduler.c:1427-1476)."""
+        return self.loop.wait(timeout_s)
+
     def close(self) -> None:
         while self._noisy_given > 0:
             self.rt.remove_noisy()

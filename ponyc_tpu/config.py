@@ -115,6 +115,19 @@ class RuntimeOptions:
     #   single behaviour, no spawns/destroy/error/sync-construction;
     #   others fall back to the XLA path). The north-star dispatch
     #   kernel; off until measured on the real chip.
+    host_fastpath: bool = True     # host-sender → host-target messages
+    #   bypass the device mailbox table: they queue host-side and
+    #   dispatch at host boundaries (≙ the main-thread scheduler's
+    #   inject_main lane, scheduler.c:47,179-190 — main-thread actors
+    #   message each other without crossing schedulers). Per-sender-pair
+    #   FIFO is preserved (a host sender's messages to a host receiver
+    #   ALL take this lane; device senders all take the device lane);
+    #   lifts the host-plane ceiling ~the device-window cost per hop
+    #   (benchmarks.md "host-bridge ceiling"). False restores the
+    #   everything-through-the-device-table path.
+    host_fastpath_budget: int = 100_000  # max fast-lane dispatches per
+    #   host boundary; leftovers keep the loop busy (starvation guard so
+    #   a host ping-pong cannot lock out device progress)
     dispatch_gating: bool = False  # skip a behaviour's planar evaluation
     #   under a scalar lax.cond when no lane's current batch slot selects
     #   it (engine scan_body). Semantics-identical (behaviours are

@@ -111,6 +111,8 @@ def lib() -> ctypes.CDLL:
                                        c.POINTER(c.c_int32), c.c_int32]
         l.ponyx_asio_pending.restype = c.c_int64
         l.ponyx_asio_pending.argtypes = [c.c_void_p]
+        l.ponyx_asio_wait.restype = c.c_int32
+        l.ponyx_asio_wait.argtypes = [c.c_void_p, c.c_int32]
         l.ponyx_asio_noisy_add.argtypes = [c.c_void_p]
         l.ponyx_asio_noisy_remove.argtypes = [c.c_void_p]
         l.ponyx_asio_noisy_count.restype = c.c_int64
@@ -546,6 +548,14 @@ class AsioLoop:
 
     def pending(self) -> int:
         return int(self._l.ponyx_asio_pending(self._h))
+
+    def wait(self, timeout_s: float) -> bool:
+        """Block until the event queue is non-empty or the timeout
+        passes (≙ a quiescing scheduler suspended until the ASIO thread
+        wakes it, scheduler.c:1427-1476); True if events are pending.
+        Releases the GIL for the duration (plain ctypes call)."""
+        return bool(self._l.ponyx_asio_wait(
+            self._h, max(0, int(timeout_s * 1e3))))
 
     def noisy_add(self) -> None:
         self._l.ponyx_asio_noisy_add(self._h)

@@ -34,6 +34,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -78,6 +80,12 @@ struct Loop {
   std::unordered_map<int, int32_t> by_signum;
   int32_t next_id = 1;
   std::atomic<int64_t> noisy{0};
+  // Event-arrival wait (ponyx_asio_wait): lets the host driver BLOCK
+  // until the epoll thread queues an event instead of poll-sleeping —
+  // ≙ a sleeping scheduler woken by the ASIO thread
+  // (ponyint_sched_maybe_wakeup from asio, scheduler.c:1427-1476).
+  std::mutex wmu;
+  std::condition_variable wcv;
 };
 
 // Process-wide signal routing: the async-signal-safe handler writes the
@@ -98,6 +106,10 @@ void push_event(Loop* l, const Sub* s, Kind kind, int32_t arg,
                 int32_t flags) {
   int32_t w[6] = {s->id, s->owner, s->behaviour, kind, arg, flags};
   ponyx_mpscq_push(l->events, w, 6);
+  // Wake a blocked ponyx_asio_wait. The empty critical section orders
+  // the push before the waiter's predicate re-check (no lost wakeup).
+  { std::lock_guard<std::mutex> g(l->wmu); }
+  l->wcv.notify_one();
 }
 
 void loop_main(Loop* l) {
@@ -417,6 +429,20 @@ int32_t ponyx_asio_drain(ponyx_asio_t* l, int32_t* out,
 
 int64_t ponyx_asio_pending(ponyx_asio_t* l) {
   return ponyx_mpscq_count(l->events);
+}
+
+// Block the calling (host-driver) thread until the event queue is
+// non-empty or `timeout_ms` passes; returns 1 if events are pending.
+// ≙ a quiescing scheduler blocking until the ASIO thread wakes it
+// (perhaps_suspend_scheduler / ponyint_sched_maybe_wakeup) — the host
+// loop uses this instead of backoff poll-sleeps when the only pending
+// work is external I/O.
+int32_t ponyx_asio_wait(ponyx_asio_t* l, int32_t timeout_ms) {
+  if (ponyx_mpscq_count(l->events) > 0) return 1;
+  std::unique_lock<std::mutex> lk(l->wmu);
+  l->wcv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                  [l] { return ponyx_mpscq_count(l->events) > 0; });
+  return ponyx_mpscq_count(l->events) > 0 ? 1 : 0;
 }
 
 // ≙ ponyint_asio_noisy_add/remove + count (asio.c:80-91): subscriptions

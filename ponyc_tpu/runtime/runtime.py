@@ -171,6 +171,10 @@ class Runtime:
         self.state: Optional[RtState] = None  # via the property below
         self._step = None
         self._inject_q: collections.deque = collections.deque()
+        # Host fast lane (opts.host_fastpath): host-sender → host-target
+        # messages, dispatched at host boundaries without touching the
+        # device mailbox table (≙ inject_main, scheduler.c:179-190).
+        self._host_fast_q: collections.deque = collections.deque()
         self._free: Dict[str, List[int]] = {}
         self._host_state: Dict[int, Dict[str, Any]] = {}
         self._exit_code = 0
@@ -417,7 +421,8 @@ class Runtime:
                     v = int(stt.get(fname, -1))
                     if 0 <= v < self.program.total:
                         extra[v] = True
-        for t, w in self._inject_q:
+        import itertools
+        for t, w in itertools.chain(self._inject_q, self._host_fast_q):
             if 0 <= t < self.program.total:
                 extra[t] = True
             gid = int(w[0])
@@ -538,7 +543,16 @@ class Runtime:
             for spec, a in zip(behaviour_def.arg_specs, args):
                 if pack.cap_mode(spec) == "iso" and int(a) > 0:
                     heap.send_iso(int(a))
-        self._inject_q.append((int(target), words))
+        # Host senders (the API and host behaviours both run here) to
+        # host targets take the fast lane; everything else rides the
+        # device inject path. Per-sender-pair FIFO holds: a given
+        # sender's messages to a given receiver always take ONE lane.
+        if (self.opts.host_fastpath
+                and 0 <= int(target) < self.program.total
+                and self.program.cohort_of(int(target)).host):
+            self._host_fast_q.append((int(target), words))
+        else:
+            self._inject_q.append((int(target), words))
 
     def bulk_send(self, targets, behaviour_def: BehaviourDef, *arg_cols):
         """Mass-enqueue one message per (distinct) target directly into the
@@ -756,42 +770,83 @@ class Runtime:
             for k in range(int(pending[i])):
                 msg = cbuf[(head[i] + k) % c, :, col]
                 consumed += 1
-                gid = int(msg[0])
-                bdef = (self.program.behaviour_table[gid]
-                        if 0 <= gid < len(self.program.behaviour_table)
-                        else None)
-                if bdef is None or bdef.actor_type is not cohort.atype:
-                    self.totals["badmsg"] += 1
-                    continue
-                ctx = HostContext(self, aid)
-                st = self._host_state.get(aid, {})
-                args = _host_unpack_args(bdef.arg_specs, msg[1:])
-                heap = getattr(self, "_heap", None)
-                if heap is not None:
-                    # Delivery completes the iso move: the receiver may
-                    # peek/unbox now (≙ the gc.c recv handler).
-                    for spec, a in zip(bdef.arg_specs, args):
-                        if pack.cap_mode(spec) == "iso" and int(a) > 0:
-                            heap.receive(int(a))
-                try:
-                    st2 = bdef.fn(ctx, st, *args)
-                except PonyError as e:
-                    # ≙ a behaviour-local `try...else` (fork int-coded
-                    # errors): record the code, actor continues.
-                    self._host_errors[aid] = e.code
-                    self._host_error_locs[aid] = e.loc
-                    self.totals["host_errors"] += 1
-                    st2 = st
-                self._host_state[aid] = st2 if st2 is not None else st
-                self.totals["host_processed"] += 1
-                if ctx.exit_flag:
-                    self._exit_code = ctx.exit_code
-                    self._exit_requested = True
-                if ctx.yield_flag:
+                ctx = self._dispatch_host_msg(aid, cohort, int(msg[0]),
+                                              msg[1:])
+                if ctx is not None and ctx.yield_flag:
                     break
             new_head[i] = head[i] + consumed
         self.state = self._replace(
             head=self.state.head.at[rows_j].set(jnp.asarray(new_head)))
+        return True
+
+    def _dispatch_host_msg(self, aid: int, cohort, gid: int, payload):
+        """Dispatch ONE message to a host-resident actor — shared by the
+        device-mailbox drain above and the fast lane below so their
+        semantics (iso receive, PonyError residue, exit/yield flags,
+        counters) cannot drift. Returns the HostContext, or None for a
+        badmsg."""
+        bdef = (self.program.behaviour_table[gid]
+                if 0 <= gid < len(self.program.behaviour_table)
+                else None)
+        if bdef is None or bdef.actor_type is not cohort.atype:
+            self.totals["badmsg"] += 1
+            return None
+        ctx = HostContext(self, aid)
+        st = self._host_state.get(aid, {})
+        args = _host_unpack_args(bdef.arg_specs, payload)
+        heap = getattr(self, "_heap", None)
+        if heap is not None:
+            # Delivery completes the iso move: the receiver may
+            # peek/unbox now (≙ the gc.c recv handler).
+            for spec, a in zip(bdef.arg_specs, args):
+                if pack.cap_mode(spec) == "iso" and int(a) > 0:
+                    heap.receive(int(a))
+        try:
+            st2 = bdef.fn(ctx, st, *args)
+        except PonyError as e:
+            # ≙ a behaviour-local `try...else` (fork int-coded
+            # errors): record the code, actor continues.
+            self._host_errors[aid] = e.code
+            self._host_error_locs[aid] = e.loc
+            self.totals["host_errors"] += 1
+            st2 = st
+        self._host_state[aid] = st2 if st2 is not None else st
+        self.totals["host_processed"] += 1
+        if ctx.exit_flag:
+            self._exit_code = ctx.exit_code
+            self._exit_requested = True
+        return ctx
+
+    def _drain_host_fast(self, budget: int) -> bool:
+        """Dispatch queued fast-lane messages (host→host sends) up to
+        `budget`; leftovers keep the run loop busy. A target with no
+        host state was never spawned — dead-letter, matching the device
+        path's to-dead drop."""
+        q = self._host_fast_q
+        if not q:
+            return False
+        n = 0
+        yielded = set()      # actors that yield_()ed: stop their batch
+        held = []            # their remaining messages, order preserved
+        while q and n < budget:
+            aid, w = q.popleft()
+            if aid in yielded:
+                held.append((aid, w))
+                continue
+            n += 1
+            if aid not in self._host_state:
+                self.totals["deadletter_host"] += 1
+                continue
+            cohort = self.program.cohort_of(aid)
+            ctx = self._dispatch_host_msg(aid, cohort, int(w[0]), w[1:])
+            if ctx is not None and ctx.yield_flag:
+                # ≙ the device drain honouring yield mid-batch
+                # (actor.c:675-679): this actor processes nothing more
+                # this boundary; its queue order is preserved.
+                yielded.add(aid)
+            if self._exit_requested:
+                break
+        q.extendleft(reversed(held))
         return True
 
     # ---- the run loop (≙ pony_start → scheduler run → quiescence) ----
@@ -849,6 +904,11 @@ class Runtime:
                 self._drain_host()
             for p in self._bridge_pollers:
                 p.poll(self)
+            # Fast lane: host→host messages (including any the drains
+            # and pollers just produced) dispatch NOW, without waiting
+            # a device window per hop (≙ inject_main staying on the
+            # main-thread scheduler).
+            self._drain_host_fast(self.opts.host_fastpath_budget)
             # Periodic collection (≙ the cycle detector triggered off the
             # scheduler-0 idle path every --ponycdinterval,
             # scheduler.c:976-989) — only when something can actually be
@@ -872,7 +932,7 @@ class Runtime:
                 self._exit_requested = False    # consume the request
                 break
             busy = (bool(a.device_pending) or bool(a.host_pending)
-                    or bool(self._inject_q))
+                    or bool(self._inject_q) or bool(self._host_fast_q))
             if not busy:
                 terminating = (self._noisy == 0
                                and (not self._bridge_pollers
@@ -903,10 +963,21 @@ class Runtime:
                         self._analysis.window(a)
                     break  # quiescent: terminate (≙ ACK'd CNF token)
                 idle_polls += 1
-                # Waiting on external events (timers/fds): back off
-                # exponentially instead of hot-spinning device steps
+                # Waiting on external events (timers/fds): BLOCK on the
+                # asio queue when a bridge is attached — the native
+                # epoll thread wakes us the instant an event lands
+                # (≙ a suspended scheduler woken by the ASIO thread,
+                # scheduler.c:1427-1476) — else back off exponentially
                 # (≙ the fork's scaling_sleep, scheduler.c:918-935).
-                time.sleep(min(0.002, 2e-5 * (1 << min(idle_polls, 7))))
+                # The cap only bounds non-asio pollers' cadence
+                # (process reaping, resolver completions).
+                waiter = next((p for p in self._bridge_pollers
+                               if hasattr(p, "wait")), None)
+                if waiter is not None:
+                    waiter.wait(0.02)
+                else:
+                    time.sleep(min(0.002,
+                                   2e-5 * (1 << min(idle_polls, 7))))
             else:
                 idle_polls = 0
             if max_steps is not None and steps_this_run >= max_steps:

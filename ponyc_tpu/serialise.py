@@ -38,7 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2 (round 5): adds the host fast-lane queue (fastq_tgt/fastq_words) —
+# bumped so a pre-fast-lane build REJECTS v2 snapshots loudly instead of
+# silently dropping queued host→host messages.
+FORMAT_VERSION = 2
+_ACCEPTED_FORMATS = (1, 2)     # v1 restores with an empty fast queue
 
 
 class FingerprintMismatch(RuntimeError):
@@ -85,6 +89,13 @@ def save(rt, path: str) -> None:
     else:
         arrays["inject_words"] = np.zeros(
             (0, 1 + rt.opts.msg_words), np.int32)
+    fast = list(rt._host_fast_q)
+    arrays["fastq_tgt"] = np.asarray([t for t, _ in fast], np.int32)
+    if fast:
+        arrays["fastq_words"] = np.stack([w for _, w in fast])
+    else:
+        arrays["fastq_words"] = np.zeros(
+            (0, 1 + rt.opts.msg_words), np.int32)
 
     header = {
         "format": FORMAT_VERSION,
@@ -115,9 +126,10 @@ def restore(rt, path: str) -> None:
         raise RuntimeError("call start() before restore()")
     with np.load(path, allow_pickle=False) as z:
         header = json.loads(bytes(z["header"]).decode())
-        if header["format"] != FORMAT_VERSION:
+        if header["format"] not in _ACCEPTED_FORMATS:
             raise FingerprintMismatch(
-                f"snapshot format {header['format']} != {FORMAT_VERSION}")
+                f"snapshot format {header['format']} not in "
+                f"{_ACCEPTED_FORMATS}")
         fp = fingerprint(rt.program)
         if header["fingerprint"] != fp:
             raise FingerprintMismatch(
@@ -144,6 +156,12 @@ def restore(rt, path: str) -> None:
         words = z["inject_words"]
         for i in range(len(tgts)):
             rt._inject_q.append((int(tgts[i]), words[i]))
+        rt._host_fast_q.clear()
+        if "fastq_tgt" in z:       # absent in pre-fast-lane snapshots
+            ftgts = z["fastq_tgt"]
+            fwords = z["fastq_words"]
+            for i in range(len(ftgts)):
+                rt._host_fast_q.append((int(ftgts[i]), fwords[i]))
     rt._free = {k: [int(x) for x in v] for k, v in header["free"].items()}
     rt._host_state = {int(k): v for k, v in header["host_state"].items()}
     rt.totals.clear()

@@ -26,6 +26,8 @@ from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
 class PumpServer:
     HOST = True
     n_msgs: I32
+    n_closed: I32
+    n_conns: I32
 
     @behaviour
     def on_accept(self, st, conn: I32):
@@ -39,7 +41,12 @@ class PumpServer:
 
     @behaviour
     def on_closed(self, st, conn: I32):
-        return st
+        # All clients hung up -> measurement over (the listener holds
+        # the runtime alive otherwise and run() would spin out its
+        # step budget -- the round-5 mis-measurement).
+        done = st["n_closed"] + 1
+        self.exit(0, when=done >= st["n_conns"])
+        return {**st, "n_closed": done}
 
 
 def make_client(m_msgs: int):
@@ -77,7 +84,7 @@ def main(clients: int, m_msgs: int):
                                 msg_words=4, inject_slots=256))
     rt.declare(PumpServer, 1).declare(cli_t, clients).start()
     net = rt.attach_net()
-    srv = rt.spawn(PumpServer)
+    srv = rt.spawn(PumpServer, n_conns=clients)
     lid = net.listen_tcp("127.0.0.1", 0, srv,
                          on_accept=PumpServer.on_accept,
                          on_data=PumpServer.on_data,

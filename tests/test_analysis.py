@@ -250,3 +250,22 @@ def test_chrome_trace_export(tmp_path):
     out2 = str(tmp_path / "t2.json")
     assert cli_main(["trace", path, "-o", out2]) == 0
     assert json.load(open(out2))["traceEvents"]
+
+
+def test_host_rss_cpu_accounting(tmp_path):
+    """Host-loop CPU/RSS accounting (≙ ponyint_update_memory_usage,
+    sched/cpu.c): every window row carries the process's current RSS
+    and cumulative CPU time; the dump prints them too."""
+    path = str(tmp_path / "an.csv")
+    rt, ids = _build(8, analysis=2, analysis_path=path)
+    rt.send(int(ids[0]), ring.RingNode.token, 40)
+    rt.run()
+    text = rt._analysis.dump(out=open(os.devnull, "w"))
+    assert "host_rss_kb=" in text and "host_cpu_ms=" in text
+    rt.stop()
+    lines = open(path).read().strip().split("\n")
+    header = lines[0].split(",")
+    assert header[-2:] == ["rss_kb", "cpu_ms"]
+    rows = [dict(zip(header, l.split(","))) for l in lines[1:]]
+    assert all(int(r["rss_kb"]) > 1000 for r in rows)      # > 1 MB RSS
+    assert all(float(r["cpu_ms"]) > 0 for r in rows)

@@ -1,0 +1,153 @@
+"""Host fast lane (RuntimeOptions.host_fastpath): host→host messages
+bypass the device mailbox table (≙ inject_main keeping main-thread
+actors on the main-thread scheduler, scheduler.c:47,179-190) with
+identical semantics — per-sender-pair FIFO, quiescence, checkpointing,
+dead-letter on unspawned targets."""
+
+import numpy as np
+
+from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+
+OPTS = dict(mailbox_cap=8, batch=2, max_sends=2, msg_words=2,
+            inject_slots=16)
+
+
+@actor
+class HostCounter:
+    HOST = True
+    n: I32
+    last: I32
+
+    @behaviour
+    def hit(self, st, v: I32):
+        return {"n": st["n"] + 1, "last": v}
+
+
+@actor
+class HostChain:
+    HOST = True
+    nxt: Ref
+    hops: I32
+
+    @behaviour
+    def pass_(self, st, k: I32):
+        if k > 0:
+            self.send(st["nxt"], HostChain.pass_, k - 1)
+        return {**st, "hops": st["hops"] + 1}
+
+
+@actor
+class DevPing:
+    out: Ref
+    fired: I32
+    MAX_SENDS = 1
+
+    @behaviour
+    def go(self, st, v: I32):
+        self.send(st["out"], HostCounter.hit, v)
+        return {**st, "fired": st["fired"] + 1}
+
+
+def test_fast_lane_preserves_order_and_count():
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(HostCounter, 2).start()
+    a = rt.spawn(HostCounter)
+    for v in range(50):
+        rt.send(a, HostCounter.hit, v)
+    assert len(rt._host_fast_q) == 50          # took the fast lane
+    assert rt.run(max_steps=50) == 0
+    st = rt.state_of(a)
+    assert st["n"] == 50 and st["last"] == 49  # FIFO: last send last
+
+
+def test_host_chain_completes_within_few_boundaries():
+    """A host→host relay chain drains at host boundaries without one
+    device window per hop — the whole chain fits one run() in far
+    fewer steps than hops."""
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(HostChain, 2).start()
+    a = rt.spawn(HostChain)
+    b = rt.spawn(HostChain, nxt=a)
+    rt.set_fields(HostChain, np.asarray([a]), nxt=b)
+    rt.send(a, HostChain.pass_, 100)
+    assert rt.run(max_steps=20) == 0
+    total = sum(rt.state_of(x)["hops"] for x in (a, b))
+    assert total == 101
+    assert rt.steps_run < 20                   # not one window per hop
+
+
+def test_device_to_host_still_rides_the_device_lane():
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(DevPing, 1).declare(HostCounter, 1).start()
+    h = rt.spawn(HostCounter)
+    d = rt.spawn(DevPing, out=h)
+    rt.send(d, DevPing.go, 7)                  # device target: inject lane
+    assert rt.run(max_steps=16) == 0
+    assert rt.state_of(h) == {"n": 1, "last": 7}
+
+
+def test_fastpath_opt_out_matches():
+    res = {}
+    for fast in (True, False):
+        rt = Runtime(RuntimeOptions(host_fastpath=fast, **OPTS))
+        rt.declare(HostCounter, 1).start()
+        a = rt.spawn(HostCounter)
+        for v in range(20):
+            rt.send(a, HostCounter.hit, v)
+        if not fast:
+            assert not rt._host_fast_q
+        rt.run(max_steps=64)
+        res[fast] = dict(rt.state_of(a))
+    assert res[True] == res[False] == {"n": 20, "last": 19}
+
+
+def test_checkpoint_carries_queued_fast_messages(tmp_path):
+    from ponyc_tpu import serialise
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(HostCounter, 1).start()
+    a = rt.spawn(HostCounter)
+    for v in range(5):
+        rt.send(a, HostCounter.hit, v)
+    path = str(tmp_path / "w.npz")
+    serialise.save(rt, path)
+    rt2 = Runtime(RuntimeOptions(**OPTS))
+    rt2.declare(HostCounter, 1).start()
+    serialise.restore(rt2, path)
+    assert len(rt2._host_fast_q) == 5
+    rt2.run(max_steps=16)
+    assert rt2.state_of(a) == {"n": 5, "last": 4}
+
+
+def test_unspawned_host_target_dead_letters():
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(HostCounter, 2).start()
+    a = rt.spawn(HostCounter)
+    ghost = a + 1 if rt.program.cohort_of(a + 1).host else a - 1
+    rt.send(int(ghost), HostCounter.hit, 1)
+    rt.run(max_steps=8)
+    assert rt.totals["deadletter_host"] == 1
+    assert rt.state_of(a)["n"] == 0
+
+
+def test_yield_stops_fast_lane_batch():
+    """yield_() on the fast lane stops that actor's batch for the
+    boundary, exactly like the device-mailbox drain (actor.c:675-679) —
+    round-5 review regression."""
+    @actor
+    class Yielding:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def hit(self, st, v: I32):
+            self.yield_()                 # one message per boundary
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Yielding, 1).start()
+    a = rt.spawn(Yielding)
+    for v in range(4):
+        rt.send(a, Yielding.hit, v)
+    rt.run(max_steps=64)
+    assert rt.state_of(a)["n"] == 4       # all arrive eventually...
+    assert rt.steps_run >= 4              # ...but one boundary each
