@@ -179,6 +179,10 @@ class Runtime:
         self._host_state: Dict[int, Dict[str, Any]] = {}
         self._exit_code = 0
         self._exit_requested = False
+        self._device_dirty = True     # force the first window of a run
+        self._idle_boundaries = 0     # lifetime skipped host-only
+        #   boundaries; feeds the cd_interval GC cadence so host-heavy
+        #   phases still collect (steps_run freezes while skipping)
         self._noisy = 0          # ≙ asio noisy_count keeping runtime alive
         self._bridge_pollers: List[Any] = []   # asio backends (bridge/)
         self.steps_run = 0
@@ -205,6 +209,11 @@ class Runtime:
     def state(self, v) -> None:
         self._state = v
         self._freelist_key = None
+        # Any host-side state write may have created device work the
+        # last window's aux cannot know about (bulk_send's direct
+        # mailbox writes, restore(), flag flips) — the run loop's
+        # host-only-boundary skip must not trust stale quiescence.
+        self._device_dirty = True
 
     # ---- construction (≙ pony_init) ----
     def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
@@ -864,32 +873,59 @@ class Runtime:
         qi = max(1, self.opts.quiesce_interval)
         idle_polls = 0
         steps_this_run = 0
+        skipped_boundaries = 0
+        a = None          # last window's aux; None forces a first window
         while True:
-            # One fused device dispatch advances up to `budget` ticks
-            # (engine.build_multi_step); the window self-terminates the
-            # tick host attention is needed, so host latency matches the
-            # old one-step-per-dispatch loop.
-            budget = qi
-            if max_steps is not None:
-                budget = min(budget, max_steps - steps_this_run)
-            inj = self._drain_inject()
-            self.state, aux, kdev = self._multi(
-                self.state, *inj, jnp.int32(max(1, budget)))
-            k, a = jax.device_get((kdev, aux))
-            k = int(k)
-            self.steps_run += k
-            steps_this_run += k
-            if self.opts.debug_checks:
-                self.check_invariants()
-            # aux counters are cumulative int32; accumulate mod-2^32 deltas
-            # so fetch cadence doesn't matter (< 2^31 events per window).
-            for key, cur in (("processed", int(a.n_processed) & 0xFFFFFFFF),
-                             ("delivered", int(a.n_delivered) & 0xFFFFFFFF)):
-                last = self._last_counters.get(key, 0)
-                self.totals[key] += (cur - last) & 0xFFFFFFFF
-                self._last_counters[key] = cur
-            if getattr(self, "_analysis", None) is not None:
-                self._analysis.window(a)
+            # A boundary where the device is provably quiescent and
+            # nothing needs injecting is HOST-ONLY: skip the device
+            # dispatch entirely (≙ idle schedulers staying asleep while
+            # the main-thread scheduler works, scheduler.c:527-746).
+            # Sound because with no injects and no pending device work,
+            # a window could neither dispatch nor deliver anything —
+            # device facts in `a` cannot change. Skipped boundaries
+            # count against max_steps so a runaway host program stays
+            # bounded exactly like a device one.
+            if (a is not None and not bool(a.device_pending)
+                    and not bool(a.host_pending)
+                    and not self._inject_q
+                    and not getattr(self, "_device_dirty", True)):
+                skipped_boundaries += 1
+                self._idle_boundaries += 1
+            else:
+                # One fused device dispatch advances up to `budget`
+                # ticks (engine.build_multi_step); the window
+                # self-terminates the tick host attention is needed, so
+                # host latency matches the old one-step-per-dispatch
+                # loop.
+                budget = qi
+                if max_steps is not None:
+                    budget = min(budget, max_steps - steps_this_run
+                                 - skipped_boundaries)
+                inj = self._drain_inject()
+                self.state, aux, kdev = self._multi(
+                    self.state, *inj, jnp.int32(max(1, budget)))
+                k, a = jax.device_get((kdev, aux))
+                # The window just observed (and advanced) true device
+                # state; until the next host-side state write, its aux
+                # is authoritative for the skip decision.
+                self._device_dirty = False
+                k = int(k)
+                self.steps_run += k
+                steps_this_run += k
+                if self.opts.debug_checks:
+                    self.check_invariants()
+                # aux counters are cumulative int32; accumulate
+                # mod-2^32 deltas so fetch cadence doesn't matter
+                # (< 2^31 events per window).
+                for key, cur in (("processed",
+                                  int(a.n_processed) & 0xFFFFFFFF),
+                                 ("delivered",
+                                  int(a.n_delivered) & 0xFFFFFFFF)):
+                    last = self._last_counters.get(key, 0)
+                    self.totals[key] += (cur - last) & 0xFFFFFFFF
+                    self._last_counters[key] = cur
+                if getattr(self, "_analysis", None) is not None:
+                    self._analysis.window(a)
             if bool(a.spill_overflow):
                 raise SpillOverflowError(
                     f"spill overflow at step {self.steps_run}")
@@ -919,20 +955,29 @@ class Runtime:
             heap = getattr(self, "_heap", None)
             heap_pressure = (heap is not None
                              and heap.bytes_since_gc > self._next_gc)
+            # Cadence counts device steps + skipped host-only boundaries
+            # (steps_run freezes while boundaries are skipped; host-heavy
+            # phases must still collect periodically).
+            eff_step = self.steps_run + self._idle_boundaries
             if (not self.opts.noblock
                     and (self._ever_released
                          or self.program.has_device_spawns)
                     and (heap_pressure
                          or (self.opts.cd_interval > 0
-                             and self.steps_run - self._last_gc_step
+                             and eff_step - self._last_gc_step
                              >= self.opts.cd_interval))):
-                self._last_gc_step = self.steps_run
+                self._last_gc_step = eff_step
                 self.gc()
             if self._exit_requested:
                 self._exit_requested = False    # consume the request
                 break
+            # A dirty device (host-side state write since the last
+            # window — e.g. bulk_send's direct mailbox writes from a
+            # host behaviour) is not provably quiet: stay busy so the
+            # next iteration runs a window before quiescence can hold.
             busy = (bool(a.device_pending) or bool(a.host_pending)
-                    or bool(self._inject_q) or bool(self._host_fast_q))
+                    or bool(self._inject_q) or bool(self._host_fast_q)
+                    or getattr(self, "_device_dirty", False))
             if not busy:
                 terminating = (self._noisy == 0
                                and (not self._bridge_pollers
@@ -948,7 +993,8 @@ class Runtime:
                     cleanup = 0
                     while (bool(a.any_muted) and cleanup < 3
                            and (max_steps is None
-                                or steps_this_run < max_steps)):
+                                or steps_this_run + skipped_boundaries
+                                < max_steps)):
                         self.state, aux2, kdev = self._multi(
                             self.state, *self._empty_inject, jnp.int32(1))
                         a = jax.device_get(aux2)
@@ -980,7 +1026,8 @@ class Runtime:
                                    2e-5 * (1 << min(idle_polls, 7))))
             else:
                 idle_polls = 0
-            if max_steps is not None and steps_this_run >= max_steps:
+            if max_steps is not None \
+                    and steps_this_run + skipped_boundaries >= max_steps:
                 break
         return self._exit_code
 

@@ -148,6 +148,56 @@ def test_yield_stops_fast_lane_batch():
     a = rt.spawn(Yielding)
     for v in range(4):
         rt.send(a, Yielding.hit, v)
+    # Count dispatches per host boundary (steps_run is no proxy: a
+    # host-only boundary skips the device window entirely).
+    per_boundary = []
+    orig = rt._drain_host_fast
+
+    def counted(budget):
+        before = rt.totals["host_processed"]
+        r = orig(budget)
+        d = rt.totals["host_processed"] - before
+        if d:
+            per_boundary.append(d)
+        return r
+
+    rt._drain_host_fast = counted
     rt.run(max_steps=64)
     assert rt.state_of(a)["n"] == 4       # all arrive eventually...
-    assert rt.steps_run >= 4              # ...but one boundary each
+    assert per_boundary == [1, 1, 1, 1]   # ...but one per boundary
+
+
+def test_bulk_send_from_host_behaviour_is_not_stranded():
+    """bulk_send writes device mailboxes directly (no inject queue); a
+    host behaviour doing it mid-run must still get a device window —
+    the host-only-boundary skip may not trust stale quiescence
+    (round-5 review regression: _device_dirty)."""
+    @actor
+    class DevCounter:
+        n: I32
+        MAX_SENDS = 0
+
+        @behaviour
+        def bump(self, st, v: I32):
+            return {**st, "n": st["n"] + v}
+
+    @actor
+    class HostKick:
+        HOST = True
+        done: I32
+
+        @behaviour
+        def kick(self, st, tgt: I32):
+            self.rt.bulk_send(np.asarray([tgt]), DevCounter.bump,
+                              np.asarray([5]))
+            return {**st, "done": 1}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(DevCounter, 1).declare(HostKick, 1).start()
+    d = rt.spawn(DevCounter)
+    h = rt.spawn(HostKick)
+    assert rt.run(max_steps=8) == 0       # device quiesces empty
+    rt.send(h, HostKick.kick, d)          # fast lane → bulk_send mid-run
+    assert rt.run(max_steps=32) == 0
+    assert int(rt.cohort_state(DevCounter)["n"][0]) == 5
+    assert rt.state_of(h)["done"] == 1
