@@ -11,21 +11,21 @@ tick (ICI collectives across chips), and I/O + bookkeeping stay host-side.
 See SURVEY.md at the repo root for the full mapping to the reference.
 """
 
-from .api import (Actor, Bool, Box, Context, F32, I8, I16, I32, Iso,
-                  Mut, Ref, Tag, Trn, TypeParam, U8, U16, U32, Val,
+from .api import (Actor, Blob, Bool, Box, Context, F32, I8, I16, I32,
+                  Iso, Mut, Ref, Tag, Trn, TypeParam, U8, U16, U32, Val,
                   VecF32, VecI32, actor, be, behaviour)
 from .config import RuntimeOptions, options_from_env, strip_runtime_flags
 from .program import Program
-from .runtime.runtime import (Runtime, SpawnCapacityError,
-                              SpillOverflowError)
+from .runtime.runtime import (BlobCapacityError, Runtime,
+                              SpawnCapacityError, SpillOverflowError)
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Actor", "Bool", "Box", "Context", "F32", "I8", "I16", "I32", "Iso",
+    "Actor", "Blob", "Bool", "Box", "Context", "F32", "I8", "I16", "I32", "Iso",
     "Mut", "Ref", "Tag", "Trn", "TypeParam", "U8", "U16", "U32", "Val",
     "VecF32", "VecI32", "actor", "be",
     "behaviour", "RuntimeOptions", "options_from_env",
     "strip_runtime_flags", "Program", "Runtime", "SpillOverflowError",
-    "SpawnCapacityError",
+    "SpawnCapacityError", "BlobCapacityError",
 ]

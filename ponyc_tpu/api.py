@@ -41,9 +41,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import (Bool, Box, F32, I8, I16, I32, Iso, Mut, Ref,  # noqa
-                       Tag, Trn, TypeParam, U8, U16, U32, Val, VecF32,
-                       VecI32)  # re-exported
+from .ops.pack import (Blob, Bool, Box, F32, I8, I16, I32, Iso, Mut,  # noqa
+                       Ref, Tag, Trn, TypeParam, U8, U16, U32, Val,
+                       VecF32, VecI32)  # re-exported
 
 
 class BehaviourDef:
@@ -202,7 +202,7 @@ class ActorTypeMeta(type):
         name = f"{cls.__name__}[{', '.join(disp)}]"
         ns = {"__annotations__": {}, "__qualname__": name}
         for attr in ("BATCH", "PRIORITY", "HOST", "TAG", "SPAWNS",
-                     "SPAWN_DISPATCHES", "MAX_SENDS"):
+                     "SPAWN_DISPATCHES", "MAX_SENDS", "MAX_BLOBS"):
             if attr in cls.__dict__:
                 ns[attr] = cls.__dict__[attr]
         new = ActorTypeMeta(name, (Actor,), ns)
@@ -253,6 +253,51 @@ def actor(cls):
     return ActorTypeMeta(cls.__name__, (Actor,), ns)
 
 
+class BlobPoolView:
+    """Trace-time working view of the device blob pool for ONE behaviour
+    evaluation (see ops.pack.Blob; pool arrays live in runtime.state).
+
+    The planar engine hands each behaviour branch the CURRENT pool
+    arrays plus `take` — the lane mask "this lane's batch slot selected
+    this behaviour". Every mutation (alloc/set/free) applies eagerly,
+    masked by `take & when`, to this view's working copies; because one
+    blob has exactly one owner and the take masks of a cohort's
+    behaviours are disjoint, sequential application across branches is
+    exact — no cross-branch selects, and reads observe this dispatch's
+    own earlier writes (read-your-writes).
+
+    ≙ the reference's actor heap + pony_alloc_msg payloads
+    (pony.h:332-360): alloc on the owning actor, move by message."""
+
+    __slots__ = ("data", "used", "len_", "base", "nslots", "take",
+                 "resv", "claims", "fail", "n_alloc", "n_free",
+                 "n_remote")
+
+    def __init__(self, data, used, len_, base, take, resv):
+        self.data = data            # [W, B] i32 (working copy)
+        self.used = used            # [B] bool
+        self.len_ = len_            # [B] i32
+        self.base = base            # traced i32: this shard's first handle
+        self.nslots = used.shape[0]
+        self.take = take            # [lanes] bool
+        self.resv = resv            # [sites, lanes] i32 handles, or None
+        self.claims = 0             # trace-time alloc-site counter
+        self.fail = jnp.bool_(False)     # sticky: wanted a slot, got -1
+        self.n_alloc = jnp.int32(0)
+        self.n_free = jnp.int32(0)
+        self.n_remote = jnp.int32(0)     # Blob args that arrived off-shard
+
+    def local(self, h):
+        """(local slot index, validity mask). Invalid handles map to the
+        UPPER sentinel `nslots` — JAX normalises negative indices
+        NumPy-style even under mode="drop"/"fill", so -1 would silently
+        address the last slot; an out-of-range-high index is what those
+        modes actually drop/fill."""
+        hl = h - self.base
+        ok = (h >= 0) & (hl >= 0) & (hl < self.nslots)
+        return jnp.where(ok, hl, self.nslots), ok
+
+
 class Context:
     """Per-dispatch effect collector, passed as ``self`` to behaviours.
 
@@ -266,10 +311,10 @@ class Context:
                  "spawn_claims", "destroy_called", "error_flag",
                  "error_code", "error_loc", "error_called", "ref_types",
                  "_spawn_meta", "sync_inits", "_effected", "cap_moves",
-                 "cap_types", "exit_called", "yield_called")
+                 "cap_types", "exit_called", "yield_called", "_blob")
 
     def __init__(self, actor_id, msg_words: int, spawn_resv=None,
-                 spawn_meta=None):
+                 spawn_meta=None, blob=None):
         self.actor_id = actor_id          # traced i32 scalar (global id)
         self.msg_words = msg_words
         self.sends: List[Tuple[Any, Any, Any]] = []   # (target, words, when)
@@ -304,6 +349,8 @@ class Context:
         # {target type name: {site index: (state dict, ok mask)}}.
         self.sync_inits: Dict[str, Dict[int, Any]] = {}
         self._effected = False    # trace-time: any exit()/yield_() call
+        # Device blob pool view (None = pool disabled or host dispatch).
+        self._blob: Optional[BlobPoolView] = blob
 
     # -- messaging (≙ pony_sendv, actor.c:773-834) --
     def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
@@ -572,3 +619,121 @@ class Context:
         self.error_code = jnp.where(w, jnp.asarray(code, jnp.int32),
                                     self.error_code)
         self.error_loc = jnp.where(w, jnp.int32(site), self.error_loc)
+
+    # -- device blob pool (≙ actor-heap message payloads; see
+    # ops.pack.Blob and BlobPoolView) --
+    def _require_blob(self, what: str) -> "BlobPoolView":
+        if self._blob is None:
+            raise RuntimeError(
+                f"{what}: the device blob pool is disabled — set "
+                "RuntimeOptions.blob_slots and blob_words (> 0); host "
+                "behaviours have no device pool")
+        return self._blob
+
+    def _blob_guard(self, h, what: str):
+        """Trace-time iso discipline shared by the blob ops: touching a
+        handle after it was moved (sent, or freed) is use-after-move."""
+        prev = self.cap_moves.was_moved(h)
+        if prev is not None:
+            raise TypeError(
+                f"capability: use-after-move — blob handle already moved "
+                f"by {prev} is passed to {what}")
+
+    def blob_alloc(self, length=None, when=True):
+        """Claim a fresh device blob; returns its handle ([lanes] i32,
+        -1 where `when` is false or the pool had no free slot — the
+        sticky blob-fail flag then raises host-side, like spawn_fail).
+        The slot's words are zeroed; `length` (default: the pool width)
+        records the logical word count read back by blob_length().
+        The class must declare ``MAX_BLOBS = n`` (allocs per dispatch).
+        ≙ pony_alloc / pony_alloc_msg on the owning actor's heap."""
+        b = self._require_blob("blob_alloc")
+        if b.resv is None:
+            raise RuntimeError(
+                "blob_alloc: declare MAX_BLOBS = n on the allocating "
+                "actor class (the per-dispatch alloc budget)")
+        if b.claims >= b.resv.shape[0]:
+            raise RuntimeError(
+                f"more than MAX_BLOBS={b.resv.shape[0]} blob_alloc calls "
+                "in one behaviour dispatch; raise the declared budget")
+        h = b.resv[b.claims]
+        b.claims += 1
+        w = jnp.asarray(when, jnp.bool_)
+        ok = w & b.take & (h >= 0)
+        b.fail = b.fail | jnp.any(w & b.take & (h < 0))
+        idx = jnp.where(ok, h - b.base, b.nslots)   # OOB-high → dropped
+        b.used = b.used.at[idx].set(True, mode="drop")
+        wpool = b.data.shape[0]
+        ln = (jnp.int32(wpool) if length is None
+              else jnp.clip(jnp.asarray(length, jnp.int32), 0, wpool))
+        b.len_ = b.len_.at[idx].set(
+            jnp.broadcast_to(ln, idx.shape), mode="drop")
+        b.data = b.data.at[:, idx].set(0, mode="drop")
+        b.n_alloc = b.n_alloc + jnp.sum(ok.astype(jnp.int32))
+        h2 = jnp.where(ok, h, jnp.int32(-1))
+        self.cap_types.tag(h2, "iso")
+        return h2
+
+    def blob_get(self, h, i):
+        """Read word `i` of blob `h` ([lanes] i32; 0 for null/-1 handles,
+        out-of-range words, or handles owned by another shard). Floats:
+        ``ctx.blob_get(h, i).view(jnp.float32)``."""
+        b = self._require_blob("blob_get")
+        self._blob_guard(h, "blob_get")
+        h = jnp.asarray(h, jnp.int32)
+        hl, ok = b.local(h)
+        i = jnp.asarray(i, jnp.int32)
+        nflat = b.data.shape[0] * b.nslots
+        flat = jnp.where(ok & (i >= 0) & (i < b.data.shape[0]),
+                         jnp.minimum(i, b.data.shape[0] - 1) * b.nslots
+                         + jnp.minimum(hl, b.nslots - 1), nflat)
+        return jnp.take(b.data.reshape(-1), flat, mode="fill",
+                        fill_value=0)
+
+    def blob_length(self, h):
+        """Logical word count recorded at blob_alloc ([lanes] i32; 0 for
+        null/remote handles)."""
+        b = self._require_blob("blob_length")
+        self._blob_guard(h, "blob_length")
+        h = jnp.asarray(h, jnp.int32)
+        hl, _ok = b.local(h)
+        return jnp.take(b.len_, hl, mode="fill", fill_value=0)
+
+    def blob_set(self, h, i, v, when=True):
+        """Write word `i` of blob `h` (i32; masked by `when`). Only the
+        owner holds the handle (iso), so lanes never collide; writes are
+        visible to this dispatch's later blob_get calls and to the
+        handle's next owner after a send. Floats: pass
+        ``value.view(jnp.int32)``."""
+        b = self._require_blob("blob_set")
+        self._blob_guard(h, "blob_set")
+        h = jnp.asarray(h, jnp.int32)
+        hl, okh = b.local(h)
+        i = jnp.asarray(i, jnp.int32)
+        ok = (jnp.asarray(when, jnp.bool_) & b.take & okh
+              & (i >= 0) & (i < b.data.shape[0])
+              & jnp.take(b.used, hl, mode="fill", fill_value=False))
+        flat = jnp.where(ok, jnp.minimum(i, b.data.shape[0] - 1)
+                         * b.nslots + jnp.minimum(hl, b.nslots - 1),
+                         b.data.shape[0] * b.nslots)   # OOB-high → dropped
+        v = jnp.broadcast_to(jnp.asarray(v, jnp.int32), flat.shape)
+        b.data = b.data.reshape(-1).at[flat].set(
+            v, mode="drop").reshape(b.data.shape)
+
+    def blob_free(self, h, when=True):
+        """Release blob `h` back to the pool (explicit, ≙ the owner's
+        heap dying with the actor; v1 has no orphan sweep — an unfreed,
+        unreferenced blob leaks until program end, visible as
+        counter('blobs_in_use')). Freeing is a MOVE: later use of the
+        handle in this dispatch is rejected at trace."""
+        b = self._require_blob("blob_free")
+        self._blob_guard(h, "blob_free")
+        h = jnp.asarray(h, jnp.int32)
+        hl, okh = b.local(h)
+        ok = (jnp.asarray(when, jnp.bool_) & b.take & okh
+              & jnp.take(b.used, hl, mode="fill", fill_value=False))
+        idx = jnp.where(ok, hl, b.nslots)           # OOB-high → dropped
+        b.used = b.used.at[idx].set(False, mode="drop")
+        b.len_ = b.len_.at[idx].set(0, mode="drop")
+        b.n_free = b.n_free + jnp.sum(ok.astype(jnp.int32))
+        self.cap_moves.move(h, "blob_free")

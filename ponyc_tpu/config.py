@@ -146,6 +146,19 @@ class RuntimeOptions:
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)
 
+    # --- device blob pool (≙ rich message payloads: pony_alloc_msg +
+    # actor-heap objects riding messages, pony.h:332-360 / genfun.c.
+    # Messages carry a blob HANDLE (i32, mode iso — moved-unique); the
+    # words live device-resident in a [blob_words, shards*blob_slots]
+    # pool, so payloads larger than msg_words never round-trip the
+    # host. 0 = disabled (all blob plumbing compiles away). ---
+    blob_slots: int = 0            # pool slots PER SHARD; handles are
+    #   global ids (shard * blob_slots + slot); v1 blobs are shard-local:
+    #   a handle delivered to another shard's actor reads as the null
+    #   handle -1 and counts in rt.counter("n_blob_remote")
+    blob_words: int = 0            # i32 words per blob slot (the pool's
+    #   uniform width; ctx.blob_alloc records each blob's logical length)
+
     # --- sharding (≙ the scale axis the reference lacks; SURVEY §2.4) ---
     mesh_shards: int = 1           # actor-axis shards (1 = single chip)
     route_bucket: int = 0          # per-destination all_to_all bucket
@@ -163,6 +176,12 @@ class RuntimeOptions:
             raise ValueError("batch must be >= 1")
         if self.delivery not in ("plan", "cosort"):
             raise ValueError("delivery must be 'plan' or 'cosort'")
+        if self.blob_slots < 0 or self.blob_words < 0:
+            raise ValueError("blob_slots/blob_words must be >= 0")
+        if (self.blob_slots > 0) != (self.blob_words > 0):
+            raise ValueError(
+                "blob_slots and blob_words enable the blob pool together "
+                "(both > 0) or not at all (both 0)")
 
     @property
     def overload_occ(self) -> int:

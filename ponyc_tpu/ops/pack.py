@@ -248,6 +248,40 @@ Val = _CapSpec("val")
 Box = _CapSpec("box")
 Tag = _CapSpec("tag")
 
+
+class _BlobSpec(_CapSpec):
+    """Device blob handle annotation (``Blob``).
+
+    ≙ the reference's rich message payloads that live on an ACTOR HEAP
+    and ride messages by pointer (pony_alloc_msg + gc trace,
+    pony.h:332-360; genfun.c packs a pony_msg_t per behaviour) — here
+    the "heap" is the device-resident blob pool
+    (RuntimeOptions.blob_slots × blob_words, runtime/state.py) and the
+    "pointer" is a global blob handle (i32; -1 = null). The mode is
+    fixed ``iso``: a blob has exactly ONE owner, sending the handle is a
+    MOVE (the full trace-time move/alias discipline of Iso applies),
+    and the owner reads/writes/frees it via ctx.blob_* (api.Context).
+    Unlike Iso (a HostHeap handle — host round-trip to touch), Blob
+    words are readable and writable INSIDE device behaviours."""
+
+    @property
+    def __name__(self) -> str:          # noqa: A003
+        return "Blob"
+
+
+Blob = _BlobSpec("iso")
+
+
+def is_blob(ann) -> bool:
+    """Is this annotation a device blob handle?"""
+    return isinstance(ann, _BlobSpec)
+
+
+def null_word(ann) -> int:
+    """The "no value" word for a spec: -1 for actor refs and blob
+    handles (0 is a real id for both), 0 otherwise."""
+    return -1 if (is_ref(ann) or is_blob(ann)) else 0
+
 # ≙ TK_CAP_SEND {iso, val, tag} (type/cap.c:90): the caps a value may
 # carry across an actor boundary.
 SENDABLE_CAPS = frozenset(("iso", "val", "tag"))
@@ -466,9 +500,9 @@ def normalize_annotation(ann):
     if isinstance(ann, (_RefTo, _VecSpec, _CapSpec, TypeParam)):
         return ann
     if isinstance(ann, str) and ann in ("Iso", "Trn", "Mut", "Val",
-                                        "Box", "Tag"):
+                                        "Box", "Tag", "Blob"):
         return {"Iso": Iso, "Trn": Trn, "Mut": Mut, "Val": Val,
-                "Box": Box, "Tag": Tag}[ann]
+                "Box": Box, "Tag": Tag, "Blob": Blob}[ann]
     if ann in _MARKERS:
         return ann
     if isinstance(ann, str) and ann.endswith("]"):

@@ -70,6 +70,25 @@ class Cohort:
         #   the target cohort's compacted free-row list (static partition)
         sd = getattr(atype, "SPAWN_DISPATCHES", None)
         self.spawn_dispatches = min(self.batch, sd) if sd else self.batch
+        # Device blob pool (≙ actor-heap payloads; ops.pack.Blob):
+        # MAX_BLOBS = per-dispatch ctx.blob_alloc budget; blob_offset is
+        # this cohort's static window into the compacted free-slot list
+        # (set by Program._resolve_blobs).
+        self.blob_sites = int(getattr(atype, "MAX_BLOBS", 0) or 0)
+        self.blob_offset = 0
+
+    @property
+    def uses_blobs(self) -> bool:
+        """Does this cohort touch the device blob pool (allocates, or
+        holds/receives Blob handles)? Decides whether the dispatch
+        threads the pool arrays (engine._cohort_dispatch)."""
+        from .ops.pack import is_blob
+        if self.blob_sites:
+            return True
+        if any(is_blob(s) for s in self.atype.field_specs.values()):
+            return True
+        return any(is_blob(s) for b in self.behaviours
+                   for s in b.arg_specs)
 
     def slot_to_gid(self, slot):
         """Cohort slot → global actor id (vectorised, numpy-friendly)."""
@@ -203,6 +222,7 @@ class Program:
                             f"{b.arg_names[i]!r} is Ref[{t}] but {t} is "
                             "not declared in this program")
         self._resolve_spawns()
+        self._resolve_blobs()
         self.frozen = True
         from . import plugin as _plugin
         if _plugin.active():
@@ -246,6 +266,34 @@ class Program:
                 cohort.spawn_offsets[tname] = offsets[tname]
                 offsets[tname] += (cohort.local_capacity
                                    * cohort.spawn_dispatches * int(sites))
+
+    def _resolve_blobs(self) -> None:
+        """Validate blob-pool usage and statically partition the free
+        list among allocating cohorts (the _resolve_spawns pattern for
+        the "actor heap"): each allocating cohort owns a
+        capacity × batch × MAX_BLOBS window; unused reservations simply
+        stay free. Blob handles are device-side values — host cohorts
+        cannot hold or receive them (the host touches blob words via
+        Runtime.blob_fetch/blob_store between steps)."""
+        from .ops.pack import is_blob
+        offset = 0
+        for cohort in self.cohorts:
+            if not cohort.uses_blobs:
+                continue
+            if self.opts.blob_slots <= 0:
+                raise TypeError(
+                    f"{cohort.atype.__name__} uses the device blob pool "
+                    "(MAX_BLOBS or Blob annotations) but the pool is "
+                    "disabled — set RuntimeOptions.blob_slots and "
+                    "blob_words")
+            if cohort.host:
+                raise TypeError(
+                    f"host actor type {cohort.atype.__name__} declares "
+                    "blob usage; blobs are device-resident — use "
+                    "Runtime.blob_fetch/blob_store host-side")
+            cohort.blob_offset = offset
+            offset += (cohort.local_capacity * cohort.batch
+                       * cohort.blob_sites)
 
     @property
     def has_device_spawns(self) -> bool:

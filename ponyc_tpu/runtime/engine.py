@@ -58,6 +58,8 @@ class StepAux(NamedTuple):
     exit_code: jnp.ndarray       # int32
     spill_overflow: jnp.ndarray  # bool — fatal: a spill buffer exceeded
     spawn_fail: jnp.ndarray      # bool — fatal: ctx.spawn found no slot
+    blob_fail: jnp.ndarray       # bool — fatal: ctx.blob_alloc found no
+    #   free pool slot (≙ pony_alloc exhausting the heap)
     any_muted: jnp.ndarray       # bool — some actor still carries a mute
     #   flag; run() uses it for bounded CLEANUP ticks at quiescence so a
     #   terminated world ends unmuted (the unmute pass lags the drain
@@ -105,17 +107,35 @@ def _bcast_lanes(v, dtype, lanes: int):
 
 def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
                    field_specs, field_dtypes, lanes: int, max_sends: int,
-                   spawn_resv=None, spawn_meta=None):
+                   spawn_resv=None, spawn_meta=None, blob=None):
     """Shared behaviour-evaluation core: build the Context, tag typed
     refs, run the traced body, validate + broadcast the state update,
     and collect when-masked send planes padded to the send budget.
     Used by BOTH dispatch formulations (the planar XLA branch below and
     ops/fused_dispatch's kernel) so their semantics cannot drift.
-    Returns (ctx, st2, tgts, words)."""
+    `blob` (device pool enabled only): an api.BlobPoolView the blob ops
+    mutate eagerly — see its docstring for why sequential application
+    is exact. Returns (ctx, st2, tgts, words)."""
     w1 = 1 + msg_words
     ctx = Context(ids_vec, msg_words, spawn_resv=spawn_resv,
-                  spawn_meta=spawn_meta)
+                  spawn_meta=spawn_meta, blob=blob)
     args = pack.unpack_args(bdef.arg_specs, payload)
+    if blob is not None:
+        # Blob handles are shard-local in v1 (state.py layout): a handle
+        # delivered across the mesh reads as null (-1) and counts — the
+        # defined remote semantics, ≙ nothing (the reference runtime is
+        # single-node; there is no remote heap to dereference).
+        nulled = []
+        for spec, a in zip(bdef.arg_specs, args):
+            if pack.is_blob(spec):
+                a = jnp.asarray(a, jnp.int32)
+                local_ok = (a >= blob.base) & (a < blob.base + blob.nslots)
+                remote = (a >= 0) & ~local_ok
+                blob.n_remote = blob.n_remote + jnp.sum(
+                    (remote & blob.take).astype(jnp.int32))
+                a = jnp.where(local_ok, a, jnp.int32(-1))
+            nulled.append(a)
+        args = nulled
     # Typed Ref[T] state fields and args enter the behaviour as PLAIN
     # arrays whose trace-time identity is tagged with the declared
     # type (pack.RefTypes), so Context.send verifies wiring at trace
@@ -252,12 +272,24 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
     engine skip dead scatters)."""
     w1 = 1 + msg_words
 
-    def branch(st, payload, ids_vec, resv_k):
+    def branch(st, payload, ids_vec, resv_k, blob_in=None, take=None):
+        bv = None
+        if blob_in is not None:
+            # (pool arrays threaded sequentially through the branches —
+            # see api.BlobPoolView for why no cross-branch select is
+            # needed; resv row may be zero-sites for receive-only types.)
+            from ..api import BlobPoolView
+            bdata, bused, blen, bbase, bresv = blob_in
+            bv = BlobPoolView(bdata, bused, blen, bbase,
+                              (take if take is not None
+                               else jnp.ones((lanes,), jnp.bool_)),
+                              bresv if (bresv is not None
+                                        and bresv.shape[0]) else None)
         ctx, st2, tgts, words = eval_behaviour(
             bdef, st, payload, ids_vec, msg_words=msg_words,
             field_specs=field_specs, field_dtypes=field_dtypes,
             lanes=lanes, max_sends=max_sends, spawn_resv=resv_k,
-            spawn_meta=spawn_meta)
+            spawn_meta=spawn_meta, blob=bv)
         effects["destroy"] = effects["destroy"] or ctx.destroy_called
         effects["error"] = effects["error"] or ctx.error_called
         effects["sync_init"] = (effects["sync_init"]
@@ -281,7 +313,7 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                 if ent is None:
                     has_l.append(jnp.zeros((lanes,), jnp.bool_))
                     for f, sp in t_specs.items():
-                        d = -1 if pack.is_ref(sp) else 0
+                        d = pack.null_word(sp)
                         vals_l[f].append(jnp.full((lanes,), d, t_dt[f]))
                 else:
                     ist, ok = ent
@@ -291,6 +323,10 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                             _bcast_lanes(ist[f], t_dt[f], lanes))
             inits.append((has_l, vals_l))
         b = jnp.bool_
+        blob_out = None
+        if bv is not None:
+            blob_out = (bv.data, bv.used, bv.len_, bv.fail,
+                        bv.n_alloc, bv.n_free, bv.n_remote)
         return (st2, (tgts, words),
                 (_bcast_lanes(ctx.exit_flag, b, lanes),
                  _bcast_lanes(ctx.exit_code, jnp.int32, lanes)),
@@ -300,7 +336,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                 _bcast_lanes(ctx.destroy_flag, b, lanes),
                 (_bcast_lanes(ctx.error_flag, b, lanes),
                  _bcast_lanes(ctx.error_code, jnp.int32, lanes),
-                 _bcast_lanes(ctx.error_loc, jnp.int32, lanes)))
+                 _bcast_lanes(ctx.error_loc, jnp.int32, lanes)),
+                blob_out)
 
     return branch
 
@@ -335,6 +372,11 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     spawn_meta = {t: program.by_type_name(t).atype.field_specs
                   for t, _ in spawn_sites}
     effects = {"destroy": False, "error": False, "sync_init": False}
+    # Device blob pool (≙ actor-heap message payloads; see ops.pack.Blob):
+    # a cohort that allocates (MAX_BLOBS) or receives/holds Blob handles
+    # threads the pool arrays through its dispatch; everything else keeps
+    # the blob-free structure (and fused-kernel eligibility) untouched.
+    use_blob = opts.blob_slots > 0 and cohort.uses_blobs
 
     def _zero_inits():
         """Zero sync-init structure — shared by the fused busy path and
@@ -353,7 +395,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
     base = cohort.behaviours[0].global_id if nb else 0
     sd = cohort.spawn_dispatches
     fused = None
-    if opts.pallas_fused and nb >= 1:
+    if opts.pallas_fused and nb >= 1 and not use_blob:
         from ..ops import fused_dispatch as fd
         from ..ops import mailbox_kernel as mk
         if rows <= fd.LANE_BLOCK or rows % fd.LANE_BLOCK == 0:
@@ -385,14 +427,20 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     fnames)
 
     def run_cohort(type_state_rows, buf_rows, head_rows, occ_rows,
-                   runnable_rows, ids, resv):
-        # buf_rows: [cap, w1, rows]; resv: {target: [sd, sites, rows]}.
+                   runnable_rows, ids, resv, blob=None):
+        # buf_rows: [cap, w1, rows]; resv: {target: [sd, sites, rows]};
+        # blob (pool-using cohorts only): dict(data [W,B], used [B],
+        # len [B], base i32, resv [batch, sites, rows] global handles).
         e = rows * batch * ms
+        if use_blob and blob is None:
+            raise RuntimeError(
+                f"cohort {cohort.atype.__name__} uses the blob pool but "
+                "run_cohort got blob=None (engine wiring)")
 
         def scan_body(carry, x):
             (st, stopped, ef, ec, sfail, dstr, errf, errc, errl, used,
-             nproc, nbad) = carry
-            msg, valid = x                    # msg [w1, rows], valid [rows]
+             nproc, nbad, blb) = carry
+            msg, valid, rblob = x             # msg [w1, rows], valid [rows]
             # Hand one dispatch-worth of spawn reservations to this batch
             # slot: a `used` counter walks the SPAWN_DISPATCHES axis;
             # exhausted budget yields -1 refs (→ sticky spawn_fail,
@@ -431,16 +479,30 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 ini_n.append((
                     [jnp.zeros((rows,), jnp.bool_) for _ in range(n)],
                     {f: [jnp.full((rows,),
-                                  -1 if pack.is_ref(sp) else 0, t_dt[f])
+                                  pack.null_word(sp), t_dt[f])
                          for _ in range(n)]
                      for f, sp in t_specs.items()}))
             def _merge(br, take, acc):
                 """Evaluate one behaviour planar and select its outputs
-                where the slot's message id matches."""
+                where the slot's message id matches. Blob pool arrays
+                thread SEQUENTIALLY (no select): branch take-masks are
+                disjoint and every blob op is already take-masked inside
+                the branch (api.BlobPoolView)."""
                 (st_a, tgt_a, wrd_a, ef_a, ec_a, yf_a, sf_a, ds_a,
-                 erf_a, erc_a, erl_a, clm_a, ini_a) = acc
+                 erf_a, erc_a, erl_a, clm_a, ini_a, blb_a) = acc
+                blob_in = None
+                if blb_a is not None:
+                    d_a, u_a, l_a = blb_a[0], blb_a[1], blb_a[2]
+                    blob_in = (d_a, u_a, l_a, blob["base"], rblob)
                 (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf,
-                 bds, (berf, berc, berl)) = br(st, msg[1:], ids, resv_k)
+                 bds, (berf, berc, berl), bl_o) = br(
+                    st, msg[1:], ids, resv_k, blob_in, take)
+                if blb_a is not None:
+                    blb_o = (bl_o[0], bl_o[1], bl_o[2],
+                             blb_a[3] | bl_o[3], blb_a[4] + bl_o[4],
+                             blb_a[5] + bl_o[5], blb_a[6] + bl_o[6])
+                else:
+                    blb_o = None
                 st_o = {k: jnp.where(take, st2[k], st_a[k]) for k in st_a}
                 tgt_o = [jnp.where(take, btgt[m], tgt_a[m])
                          for m in range(ms)]
@@ -467,10 +529,10 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                         jnp.where(take, berf, erf_a),
                         jnp.where(take, berc, erc_a),
                         jnp.where(take, berl, erl_a),
-                        clm_o, ini_o)
+                        clm_o, ini_o, blb_o)
 
             acc = (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
-                   erf_n, erc_n, erl_n, clm_n, ini_n)
+                   erf_n, erc_n, erl_n, clm_n, ini_n, blb)
             for j, br in enumerate(branches):
                 take = (do & in_range & (local == j))
                 if opts.dispatch_gating:
@@ -486,7 +548,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 else:
                     acc = _merge(br, take, acc)
             (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
-             erf_n, erc_n, erl_n, clm_n, ini_n) = acc
+             erf_n, erc_n, erl_n, clm_n, ini_n, blb) = acc
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -513,7 +575,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                      jnp.where(erf_n, erl_n, errl),
                      used + spawned_here.astype(jnp.int32),
                      nproc + (do & in_range).astype(jnp.int32),
-                     nbad + (do & ~in_range).astype(jnp.int32)),
+                     nbad + (do & ~in_range).astype(jnp.int32), blb),
                     (stgt, swrd, do, claims, inits))
 
         def busy_fn(_):
@@ -538,7 +600,7 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 return (stf, out_tgt, out_words, new_head, any_exit,
                         code, jnp.sum(nproc_l), jnp.sum(nbad_l),
                         claims_t, _zero_inits(), jnp.any(sf_l), ds_l,
-                        erf_l, erc_l, erl_l)
+                        erf_l, erc_l, erl_l, None)
             if opts.pallas:          # gate BEFORE importing pallas/mosaic
                 from ..ops import mailbox_kernel as mk
             if opts.pallas and (rows <= mk.LANE_BLOCK
@@ -553,14 +615,22 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 valids = (jnp.arange(batch, dtype=jnp.int32)[:, None]
                           < n_run[None, :])             # [batch, rows]
             z = lambda d: jnp.zeros((rows,), d)         # noqa: E731
+            if use_blob:
+                blb0 = (blob["data"], blob["used"], blob["len"],
+                        jnp.bool_(False), jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0))
+                rblob_xs = blob["resv"]        # [batch, sites, rows]
+            else:
+                blb0 = None
+                rblob_xs = None
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
                       z(jnp.int32), z(jnp.bool_), z(jnp.bool_),
                       z(jnp.bool_), z(jnp.int32), z(jnp.int32),
-                      z(jnp.int32), z(jnp.int32), z(jnp.int32))
+                      z(jnp.int32), z(jnp.int32), z(jnp.int32), blb0)
             ((stf, _, ef, ec, sfail, dstr, errf, errc, errl, _used, nproc,
-              nbad),
+              nbad, blbf),
              (stgt, swrd, consumed, claims, inits)) = lax.scan(
-                scan_body, carry0, (msgs, valids))
+                scan_body, carry0, (msgs, valids, rblob_xs))
             # stgt [batch, ms, rows] → flat [e] with rows minor;
             # swrd [batch, ms, w1, rows] → [w1, e] planar.
             n_consumed = jnp.sum(consumed.astype(jnp.int32), axis=0)
@@ -574,13 +644,16 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     tuple((h.reshape(-1),
                            {f: v.reshape(-1) for f, v in vals.items()})
                           for h, vals in inits),
-                    jnp.any(sfail), dstr, errf, errc, errl)
+                    jnp.any(sfail), dstr, errf, errc, errl, blbf)
 
         def idle_fn(_):
             # ≙ the fork's whole point (README.md:8-10, scaling_sleep): a
             # scheduler with no work must cost ~nothing. A cohort with no
             # queued runnable messages skips gather/dispatch/outbox
             # entirely — one reduction decides.
+            blb_idle = ((blob["data"], blob["used"], blob["len"],
+                         jnp.bool_(False), jnp.int32(0), jnp.int32(0),
+                         jnp.int32(0)) if use_blob else None)
             return (type_state_rows,
                     jnp.full((e,), -1, jnp.int32),
                     jnp.zeros((w1, e), jnp.int32),
@@ -593,13 +666,14 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                     jnp.zeros((rows,), jnp.bool_),
                     jnp.zeros((rows,), jnp.bool_),
                     jnp.zeros((rows,), jnp.int32),
-                    jnp.zeros((rows,), jnp.int32))
+                    jnp.zeros((rows,), jnp.int32), blb_idle)
 
         busy = jnp.any(runnable_rows & (occ_rows > 0))
         # (cond traces both branches here, so `effects` is fully
         # populated by the time the lines below read it.)
         (stf, out_tgt, out_words, new_head, any_exit, code, nproc, nbad,
-         claims_t, inits_t, sfail, dstr, errf, errc, errl) = lax.cond(
+         claims_t, inits_t, sfail, dstr, errf, errc, errl,
+         blob_out) = lax.cond(
             busy, busy_fn, idle_fn, operand=None)
         sender = jnp.tile(ids, batch * ms)    # entry (b, m, r): sender=ids[r]
         out = Entries(tgt=out_tgt, sender=sender, words=out_words)
@@ -610,7 +684,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 flat_inits if effects["sync_init"] else None,
                 sfail,
                 dstr if effects["destroy"] else None,
-                (errf, errc, errl) if effects["error"] else None)
+                (errf, errc, errl) if effects["error"] else None,
+                blob_out)
 
     return run_cohort
 
@@ -996,6 +1071,43 @@ def build_step(program: Program, opts: RuntimeOptions):
                                  base + rows, jnp.int32(-1))
                 resv[tname] = refs
             return resv
+
+        # --- 2a'. device blob pool reservations (the spawn-reservation
+        # pattern applied to the "actor heap": compact this shard's free
+        # pool slots, hand each allocating cohort its statically-
+        # partitioned window; ≙ pony_alloc on the owning actor's heap,
+        # done race-free ahead of the planar dispatch).
+        blob_en = opts.blob_slots > 0
+        if blob_en:
+            bsl = opts.blob_slots
+            bbase = shard * bsl
+            bperm, bvfree, _ = compact_mask(~st.blob_used, bsl)
+            free_blob = jnp.where(bvfree, bbase + bperm.astype(jnp.int32),
+                                  jnp.int32(-1))
+        blob_cur = (st.blob_data, st.blob_used, st.blob_len)
+        blob_fail = st.blob_fail[0]
+        nb_alloc = jnp.int32(0)
+        nb_free = jnp.int32(0)
+        nb_remote = jnp.int32(0)
+
+        def cohort_blob_resv(ch):
+            """[batch, sites, rows] reserved global blob handles: each
+            runnable actor gets batch×sites disjoint windows into the
+            compacted free list (idle actors reserve nothing)."""
+            sites = ch.blob_sites
+            if not sites:
+                return jnp.zeros((ch.batch, 0, ch.local_capacity),
+                                 jnp.int32)
+            run_c = runnable[ch.local_start:ch.local_stop]
+            rank = jnp.cumsum(run_c.astype(jnp.int32)) - 1
+            per = ch.batch * sites
+            widx = jnp.where(run_c, rank * per, 0)
+            idx = (ch.blob_offset + widx[None, None, :]
+                   + (jnp.arange(ch.batch, dtype=jnp.int32)
+                      * sites)[:, None, None]
+                   + jnp.arange(sites, dtype=jnp.int32)[None, :, None])
+            handles = jnp.take(free_blob, idx, mode="fill", fill_value=-1)
+            return jnp.where(run_c[None, None, :], handles, jnp.int32(-1))
         new_type_state: Dict[str, Dict[str, Any]] = dict(st.type_state)
         head_segments: List[jnp.ndarray] = []
         out_entries: List[Entries] = []
@@ -1013,11 +1125,23 @@ def build_step(program: Program, opts: RuntimeOptions):
         for run_cohort, ch in dispatchers:
             s0, s1 = ch.local_start, ch.local_stop
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
+            if blob_en and ch.uses_blobs:
+                blobd = {"data": blob_cur[0], "used": blob_cur[1],
+                         "len": blob_cur[2], "base": bbase,
+                         "resv": cohort_blob_resv(ch)}
+            else:
+                blobd = None
             (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, inits,
-             sfail, dstr, errs) = run_cohort(
+             sfail, dstr, errs, blob_out) = run_cohort(
                 st.type_state[ch.atype.__name__],
                 st.buf[ch.atype.__name__], st.head[s0:s1], occ0[s0:s1],
-                runnable[s0:s1], ids, cohort_resv(ch))
+                runnable[s0:s1], ids, cohort_resv(ch), blob=blobd)
+            if blob_out is not None:
+                blob_cur = (blob_out[0], blob_out[1], blob_out[2])
+                blob_fail = blob_fail | blob_out[3]
+                nb_alloc = nb_alloc + blob_out[4]
+                nb_free = nb_free + blob_out[5]
+                nb_remote = nb_remote + blob_out[6]
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
             out_entries.append(out)
@@ -1068,8 +1192,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                      for e, cl in zip(init_lists[tname], clist)])
             ts = dict(new_type_state[tname])
             for fname in ts:
-                default = (-1 if pack.is_ref(tc.atype.field_specs[fname])
-                           else 0)
+                default = pack.null_word(tc.atype.field_specs[fname])
                 if any_sync:
                     # Sync-constructed spawns (spawn_sync) land their
                     # constructor's field values; async spawns zero and
@@ -1323,7 +1446,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                 st.n_processed[0] + nproc_total,
                 st.n_delivered[0] + res.n_delivered,
                 occ_sum, n_muted_now, n_over_now,
-                nrej_all, nbad_all, ndl_all, nmut_all]), "actors")
+                nrej_all, nbad_all, ndl_all, nmut_all,
+                i32c(blob_fail)]), "actors")
             spawn_fail_any = summed[0] > 0
             device_pending = summed[1] > 0
             any_muted_all = summed[2] > 0
@@ -1334,6 +1458,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             any_rspill_all = summed[7] > 0
             nproc_all = summed[8]
             ndel_all = summed[9]
+            blob_fail_any = summed[17] > 0
             if opts.analysis >= 1:
                 occ_sum, n_muted_now, n_over_now = (summed[10], summed[11],
                                                     summed[12])
@@ -1356,6 +1481,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             any_rspill_all = any_rspill_local
             nproc_all = st.n_processed[0] + nproc_total
             ndel_all = st.n_delivered[0] + res.n_delivered
+            blob_fail_any = blob_fail
         wb_new = (any_pressured_all.astype(jnp.int32)
                   | (any_muted_all.astype(jnp.int32) << 1)
                   | (any_rspill_all.astype(jnp.int32) << 2))
@@ -1394,6 +1520,12 @@ def build_step(program: Program, opts: RuntimeOptions):
             plan_key=res.plan_key, plan_perm=res.plan_perm,
             plan_bounds=res.plan_bounds,
             world_bits=vec(wb_new),
+            blob_data=blob_cur[0], blob_used=blob_cur[1],
+            blob_len=blob_cur[2],
+            blob_fail=vec(blob_fail, jnp.bool_),
+            n_blob_alloc=vec(st.n_blob_alloc[0] + nb_alloc),
+            n_blob_free=vec(st.n_blob_free[0] + nb_free),
+            n_blob_remote=vec(st.n_blob_remote[0] + nb_remote),
             type_state=new_type_state,
         )
         aux = StepAux(
@@ -1403,6 +1535,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             exit_flag=exit_any, exit_code=exit_code_all,
             spill_overflow=overflow_any,
             spawn_fail=spawn_fail_any,
+            blob_fail=blob_fail_any,
             n_processed=nproc_all,
             n_delivered=ndel_all,
             occ_sum=occ_sum, occ_max=occ_max,
@@ -1438,7 +1571,8 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
         def cond(carry):
             _st, aux, i = carry
             go = (aux.device_pending & ~aux.host_pending & ~aux.exit_flag
-                  & ~aux.spill_overflow & ~aux.spawn_fail)
+                  & ~aux.spill_overflow & ~aux.spawn_fail
+                  & ~aux.blob_fail)
             return (i == 0) | ((i < limit) & go)
 
         def body(carry):
@@ -1455,6 +1589,7 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
             any_muted=b(False),
             exit_flag=b(False), exit_code=i32(0),
             spill_overflow=b(False), spawn_fail=b(False),
+            blob_fail=b(False),
             n_processed=i32(0), n_delivered=i32(0),
             occ_sum=i32(0), occ_max=i32(0),
             n_muted_now=i32(0), n_overloaded_now=i32(0),

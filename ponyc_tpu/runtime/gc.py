@@ -241,6 +241,13 @@ def build_gc(program: Program, opts: RuntimeOptions):
             # stale-high world bits cost one extra gather next tick and
             # the vote then corrects them.
             world_bits=st.world_bits,
+            # Blob pool passes through: v1 has no orphan sweep (an actor
+            # dying with unfreed blobs leaks them, visible via
+            # blobs_in_use — the documented explicit-free contract).
+            blob_data=st.blob_data, blob_used=st.blob_used,
+            blob_len=st.blob_len, blob_fail=st.blob_fail,
+            n_blob_alloc=st.n_blob_alloc, n_blob_free=st.n_blob_free,
+            n_blob_remote=st.n_blob_remote,
             type_state=st.type_state,
         )
         if p > 1:

@@ -65,6 +65,12 @@ class SpawnCapacityError(RuntimeError):
     destroy() return slots faster)."""
 
 
+class BlobCapacityError(RuntimeError):
+    """A device-side ctx.blob_alloc() wanted a pool slot but its window
+    had none free — raise RuntimeOptions.blob_slots, or free blobs
+    (ctx.blob_free) faster. ≙ pony_alloc exhausting the heap."""
+
+
 class HostContext:
     """Effect collector for host-resident behaviours (≙ running an actor on
     the main-thread scheduler, scheduler.c:1030-1035)."""
@@ -323,7 +329,7 @@ class Runtime:
                 else:
                     # Reused slots must not leak a previous life's state.
                     val = jnp.full((count,),
-                                   -1 if pack.is_ref(spec) else 0,
+                                   pack.null_word(spec),
                                    ts[fname].dtype)
                 ts[fname] = ts[fname].at[cols].set(val)
             new_ts = dict(self.state.type_state)
@@ -550,7 +556,11 @@ class Runtime:
         heap = getattr(self, "_heap", None)
         if heap is not None:
             for spec, a in zip(behaviour_def.arg_specs, args):
-                if pack.cap_mode(spec) == "iso" and int(a) > 0:
+                # Blob handles share the iso MODE but live in the device
+                # pool, not the HostHeap — their move discipline is the
+                # trace/device side (api.BlobPoolView), never send_iso.
+                if (pack.cap_mode(spec) == "iso"
+                        and not pack.is_blob(spec) and int(a) > 0):
                     heap.send_iso(int(a))
         # Host senders (the API and host behaviours both run here) to
         # host targets take the fast lane; everything else rides the
@@ -933,6 +943,10 @@ class Runtime:
                 raise SpawnCapacityError(
                     f"device spawn found no free slot by step "
                     f"{self.steps_run}")
+            if bool(a.blob_fail):
+                raise BlobCapacityError(
+                    f"device blob_alloc found no free pool slot by step "
+                    f"{self.steps_run}")
             if bool(a.exit_flag):
                 self._exit_code = int(a.exit_code)
                 break
@@ -1157,6 +1171,73 @@ class Runtime:
                     if getattr(v, "is_fully_addressable", True)
                     else self._fetch(v)[col].item())
                 for k, v in ts.items()}
+
+    def blob_fetch(self, handle: int) -> np.ndarray:
+        """Host-side read of a device blob's logical words (≙ receiving
+        a message payload on the main-thread scheduler). Raises on null/
+        unallocated handles."""
+        bsl = self.opts.blob_slots
+        if not (0 <= handle < self.program.shards * bsl):
+            raise IndexError(f"blob handle {handle} out of range")
+        if not bool(self._fetch(self.state.blob_used)[handle]):
+            raise KeyError(f"blob handle {handle} is not allocated")
+        ln = int(self._fetch(self.state.blob_len)[handle])
+        return self._fetch(self.state.blob_data)[:ln, handle]
+
+    def blob_store(self, words, length: Optional[int] = None) -> int:
+        """Host-side blob allocation between steps (≙ the embedder
+        building a message payload, pony.h pony_alloc_msg): claims a
+        free pool slot, writes `words` (i32, ≤ blob_words), returns the
+        handle — typically then sent as a Blob argument. The HOST owns
+        the blob until the send moves it."""
+        if self.opts.blob_slots <= 0:
+            raise RuntimeError("blob pool disabled: set "
+                               "RuntimeOptions.blob_slots/blob_words")
+        w = np.asarray(words, np.int32).reshape(-1)
+        if w.shape[0] > self.opts.blob_words:
+            raise ValueError(
+                f"{w.shape[0]} words > blob_words={self.opts.blob_words}")
+        used = self._fetch(self.state.blob_used)
+        free = np.flatnonzero(~used)
+        if free.size == 0:
+            raise BlobCapacityError("host blob_store: pool exhausted")
+        slot = int(free[0])
+        full = np.zeros((self.opts.blob_words,), np.int32)
+        full[:w.shape[0]] = w
+        ln = w.shape[0] if length is None else int(length)
+        if not 0 <= ln <= self.opts.blob_words:
+            raise ValueError(
+                f"length={ln} outside [0, blob_words="
+                f"{self.opts.blob_words}]")
+        shard = slot // self.opts.blob_slots
+        st = self.state
+        self.state = self._replace(
+            blob_data=st.blob_data.at[:, slot].set(jnp.asarray(full)),
+            blob_used=st.blob_used.at[slot].set(True),
+            blob_len=st.blob_len.at[slot].set(jnp.int32(ln)),
+            n_blob_alloc=st.n_blob_alloc.at[shard].add(1))
+        return slot
+
+    def blob_free_host(self, handle: int) -> None:
+        """Host-side release of a blob the host owns (e.g. fetched and
+        finished with). Double frees reject (counter integrity)."""
+        bsl = self.opts.blob_slots
+        if not (0 <= handle < self.program.shards * bsl):
+            raise IndexError(f"blob handle {handle} out of range")
+        if not bool(self._fetch(self.state.blob_used)[handle]):
+            raise KeyError(f"blob handle {handle} is not allocated")
+        shard = handle // bsl
+        st = self.state
+        self.state = self._replace(
+            blob_used=st.blob_used.at[handle].set(False),
+            blob_len=st.blob_len.at[handle].set(0),
+            n_blob_free=st.n_blob_free.at[shard].add(1))
+
+    @property
+    def blobs_in_use(self) -> int:
+        """Currently allocated pool slots (leak diagnostic: an actor that
+        dies without blob_free leaks its blobs — v1 has no orphan sweep)."""
+        return int(self._fetch(self.state.blob_used).sum())
 
     def cohort_state(self, atype: ActorTypeMeta) -> Dict[str, np.ndarray]:
         """State columns in *slot order* (spawn order), whatever the shard

@@ -182,6 +182,23 @@ class RtState:
     plan_perm: jnp.ndarray    # [P*E] int32 stable-sort permutation
     plan_bounds: jnp.ndarray  # [P*(n_local+1)] int32 segment bounds
 
+    # Device blob pool (≙ actor-heap message payloads — pony_alloc_msg
+    # and per-type object graphs, pony.h:332-360; see ops.pack.Blob and
+    # api.Context.blob_*): message payloads wider than msg_words live
+    # here and ride messages as moved-unique HANDLES (global id =
+    # shard * blob_slots + slot; -1 null). Planar layout like every hot
+    # array: word index major, blob slot minor (lanes). Zero-size when
+    # RuntimeOptions.blob_slots == 0 — all plumbing compiles away.
+    blob_data: jnp.ndarray    # [blob_words, P*BS] int32 payload words
+    blob_used: jnp.ndarray    # [P*BS] bool — slot allocated
+    blob_len: jnp.ndarray     # [P*BS] int32 — logical word count
+    blob_fail: jnp.ndarray    # [P] bool — sticky: an alloc found no slot
+    n_blob_alloc: jnp.ndarray   # [P] int32 — lifetime allocs
+    n_blob_free: jnp.ndarray    # [P] int32 — lifetime frees
+    n_blob_remote: jnp.ndarray  # [P] int32 — Blob args that arrived on a
+    #   shard that doesn't own them (read as null; v1 blobs are
+    #   shard-local — the documented mesh semantics)
+
     # Mesh-wide world facts from the previous tick's packed vote, stored
     # shard-uniform: bit0 = any pressured, bit1 = any muted, bit2 = any
     # route-spill entries. They gate the per-tick all_gathers/psums the
@@ -210,12 +227,12 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     for cohort in program.cohorts:
         fields = {}
         for fname, spec in cohort.atype.field_specs.items():
-            from ..ops.pack import F32, is_ref
+            from ..ops.pack import F32, null_word
             dtype = jnp.float32 if spec is F32 else jnp.int32
-            # Ref fields default to -1 ("no actor") — id 0 is a real
-            # actor, and the GC tracer treats >= 0 as an edge.
+            # Ref/blob fields default to -1 ("no actor"/"no blob" — id 0
+            # is real for both; the GC tracer treats >= 0 as an edge).
             fields[fname] = jnp.full((cohort.capacity,),
-                                     -1 if is_ref(spec) else 0, dtype)
+                                     null_word(spec), dtype)
         type_state[cohort.atype.__name__] = fields
 
     return RtState(
@@ -265,6 +282,13 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         plan_perm=jnp.zeros((p * n_entries,), i32),
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),
         world_bits=jnp.zeros((p,), i32),
+        blob_data=jnp.zeros((opts.blob_words, p * opts.blob_slots), i32),
+        blob_used=jnp.zeros((p * opts.blob_slots,), jnp.bool_),
+        blob_len=jnp.zeros((p * opts.blob_slots,), i32),
+        blob_fail=jnp.zeros((p,), jnp.bool_),
+        n_blob_alloc=jnp.zeros((p,), i32),
+        n_blob_free=jnp.zeros((p,), i32),
+        n_blob_remote=jnp.zeros((p,), i32),
         type_state=type_state,
     )
 

@@ -117,8 +117,18 @@ def behaviour_effects(bdef: BehaviourDef,
     def probe(st, args):
         resv = {t: jnp.full((max(1, n),), -1, jnp.int32)
                 for t, n in spawn_budget.items()}
+        # A tiny stand-in blob pool so blob-using behaviours probe
+        # (handles resolve to -1/no-op; budgets enforce exactly like
+        # the engine's MAX_BLOBS window).
+        from .api import BlobPoolView
+        mb = int(getattr(atype, "MAX_BLOBS", 0) or 0)
+        bv = BlobPoolView(
+            jnp.zeros((1, 1), jnp.int32), jnp.zeros((1,), jnp.bool_),
+            jnp.zeros((1,), jnp.int32), jnp.int32(0), jnp.bool_(True),
+            jnp.full((mb,), -1, jnp.int32) if mb else None)
         ctx = _ProbeContext(jnp.int32(0), msg_words, spawn_resv=resv,
-                            spawn_meta={t: {} for t in spawn_budget})
+                            spawn_meta={t: {} for t in spawn_budget},
+                            blob=bv)
         for k, v in st.items():
             ctx.ref_types.tag(v, pack.ref_target(field_specs[k]))
             ctx.cap_types.tag(v, pack.cap_mode(field_specs[k]))
