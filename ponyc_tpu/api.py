@@ -153,6 +153,14 @@ class ActorTypeMeta(type):
         # each runnable actor reserves; a step that exceeds it raises
         # SpawnCapacityError (safe, no corruption).
         cls.SPAWN_DISPATCHES = ns.get("SPAWN_DISPATCHES", None)
+        # Blob budgets (≙ per-behaviour heap allocations, heap.c):
+        # MAX_BLOBS = ctx.blob_alloc sites per dispatch; BLOB_DISPATCHES
+        # bounds how many of an actor's ≤batch dispatches per step may
+        # allocate (default: all) — each runnable actor statically
+        # reserves BLOB_DISPATCHES × MAX_BLOBS pool slots per tick, so
+        # lowering it lets a small pool serve many actors.
+        cls.MAX_BLOBS = ns.get("MAX_BLOBS", 0)
+        cls.BLOB_DISPATCHES = ns.get("BLOB_DISPATCHES", None)
         # Generic actor types (≙ formal type parameters; reify.c):
         # collect TypeParams across fields + behaviour args in first-
         # appearance order. Non-empty → the class must be reified
@@ -202,7 +210,8 @@ class ActorTypeMeta(type):
         name = f"{cls.__name__}[{', '.join(disp)}]"
         ns = {"__annotations__": {}, "__qualname__": name}
         for attr in ("BATCH", "PRIORITY", "HOST", "TAG", "SPAWNS",
-                     "SPAWN_DISPATCHES", "MAX_SENDS", "MAX_BLOBS"):
+                     "SPAWN_DISPATCHES", "MAX_SENDS", "MAX_BLOBS",
+                     "BLOB_DISPATCHES"):
             if attr in cls.__dict__:
                 ns[attr] = cls.__dict__[attr]
         new = ActorTypeMeta(name, (Actor,), ns)
@@ -271,7 +280,7 @@ class BlobPoolView:
 
     __slots__ = ("data", "used", "len_", "base", "nslots", "take",
                  "resv", "claims", "fail", "n_alloc", "n_free",
-                 "n_remote")
+                 "n_remote", "alloced")
 
     def __init__(self, data, used, len_, base, take, resv):
         self.data = data            # [W, B] i32 (working copy)
@@ -286,6 +295,8 @@ class BlobPoolView:
         self.n_alloc = jnp.int32(0)
         self.n_free = jnp.int32(0)
         self.n_remote = jnp.int32(0)     # Blob args that arrived off-shard
+        self.alloced = self.take & False   # [lanes] did this dispatch alloc
+        #   (drives the engine's blob_dispatches used-counter walk)
 
     def local(self, h):
         """(local slot index, validity mask). Invalid handles map to the
@@ -670,6 +681,7 @@ class Context:
             jnp.broadcast_to(ln, idx.shape), mode="drop")
         b.data = b.data.at[:, idx].set(0, mode="drop")
         b.n_alloc = b.n_alloc + jnp.sum(ok.astype(jnp.int32))
+        b.alloced = b.alloced | ok
         h2 = jnp.where(ok, h, jnp.int32(-1))
         self.cap_types.tag(h2, "iso")
         return h2
@@ -682,6 +694,9 @@ class Context:
         self._blob_guard(h, "blob_get")
         h = jnp.asarray(h, jnp.int32)
         hl, ok = b.local(h)
+        # Reads of unallocated (freed/stale/forged) slots yield 0, not
+        # another blob's leftover words — the same used-gate writes have.
+        ok = ok & jnp.take(b.used, hl, mode="fill", fill_value=False)
         i = jnp.asarray(i, jnp.int32)
         nflat = b.data.shape[0] * b.nslots
         flat = jnp.where(ok & (i >= 0) & (i < b.data.shape[0]),
@@ -721,11 +736,11 @@ class Context:
             v, mode="drop").reshape(b.data.shape)
 
     def blob_free(self, h, when=True):
-        """Release blob `h` back to the pool (explicit, ≙ the owner's
-        heap dying with the actor; v1 has no orphan sweep — an unfreed,
-        unreferenced blob leaks until program end, visible as
-        counter('blobs_in_use')). Freeing is a MOVE: later use of the
-        handle in this dispatch is rejected at trace."""
+        """Release blob `h` back to the pool. Explicit free is the fast
+        path; blobs whose owner died (or whose handle moved off-shard)
+        are swept by the next Runtime.gc() mark pass (≙ the owner's
+        heap dying with the actor, gc.c/heap.c). Freeing is a MOVE:
+        later use of the handle in this dispatch is rejected at trace."""
         b = self._require_blob("blob_free")
         self._blob_guard(h, "blob_free")
         h = jnp.asarray(h, jnp.int32)

@@ -76,6 +76,14 @@ class Cohort:
         # (set by Program._resolve_blobs).
         self.blob_sites = int(getattr(atype, "MAX_BLOBS", 0) or 0)
         self.blob_offset = 0
+        bdk = getattr(atype, "BLOB_DISPATCHES", None)
+        if bdk is not None and int(bdk) < 0:
+            raise TypeError(
+                f"{atype.__name__}.BLOB_DISPATCHES must be >= 0")
+        # 0 is a real value (this type reserves nothing this config);
+        # only None means "default: every dispatch may allocate".
+        self.blob_dispatches = (min(self.batch, int(bdk))
+                                if bdk is not None else self.batch)
 
     @property
     def uses_blobs(self) -> bool:
@@ -271,8 +279,8 @@ class Program:
         """Validate blob-pool usage and statically partition the free
         list among allocating cohorts (the _resolve_spawns pattern for
         the "actor heap"): each allocating cohort owns a
-        capacity × batch × MAX_BLOBS window; unused reservations simply
-        stay free. Blob handles are device-side values — host cohorts
+        capacity × BLOB_DISPATCHES × MAX_BLOBS window; unused
+        reservations simply stay free. Blob handles are device-side values — host cohorts
         cannot hold or receive them (the host touches blob words via
         Runtime.blob_fetch/blob_store between steps)."""
         from .ops.pack import is_blob
@@ -292,7 +300,7 @@ class Program:
                     "blob usage; blobs are device-resident — use "
                     "Runtime.blob_fetch/blob_store host-side")
             cohort.blob_offset = offset
-            offset += (cohort.local_capacity * cohort.batch
+            offset += (cohort.local_capacity * cohort.blob_dispatches
                        * cohort.blob_sites)
 
     @property

@@ -326,7 +326,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
         blob_out = None
         if bv is not None:
             blob_out = (bv.data, bv.used, bv.len_, bv.fail,
-                        bv.n_alloc, bv.n_free, bv.n_remote)
+                        bv.n_alloc, bv.n_free, bv.n_remote,
+                        _bcast_lanes(bv.alloced, jnp.bool_, lanes))
         return (st2, (tgts, words),
                 (_bcast_lanes(ctx.exit_flag, b, lanes),
                  _bcast_lanes(ctx.exit_code, jnp.int32, lanes)),
@@ -439,8 +440,20 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
 
         def scan_body(carry, x):
             (st, stopped, ef, ec, sfail, dstr, errf, errc, errl, used,
-             nproc, nbad, blb) = carry
-            msg, valid, rblob = x             # msg [w1, rows], valid [rows]
+             nproc, nbad, blb, bused_c) = carry
+            msg, valid = x                    # msg [w1, rows], valid [rows]
+            # Blob reservation window for this dispatch: a used-counter
+            # walk over the [blob_dispatches, sites, rows] windows — only
+            # dispatches that actually allocate consume one (the
+            # spawn_dispatches pattern; exhausted budget yields -1 refs
+            # -> sticky blob_fail, never a double claim).
+            rblob = None
+            if blb is not None:
+                rt_b = blob["resv"]
+                rblob = jnp.full(rt_b.shape[1:], -1, jnp.int32)
+                for d in range(rt_b.shape[0]):
+                    rblob = jnp.where((bused_c == d)[None, :], rt_b[d],
+                                      rblob)
             # Hand one dispatch-worth of spawn reservations to this batch
             # slot: a `used` counter walks the SPAWN_DISPATCHES axis;
             # exhausted budget yields -1 refs (→ sticky spawn_fail,
@@ -500,7 +513,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 if blb_a is not None:
                     blb_o = (bl_o[0], bl_o[1], bl_o[2],
                              blb_a[3] | bl_o[3], blb_a[4] + bl_o[4],
-                             blb_a[5] + bl_o[5], blb_a[6] + bl_o[6])
+                             blb_a[5] + bl_o[5], blb_a[6] + bl_o[6],
+                             blb_a[7] | bl_o[7])
                 else:
                     blb_o = None
                 st_o = {k: jnp.where(take, st2[k], st_a[k]) for k in st_a}
@@ -531,8 +545,10 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                         jnp.where(take, berl, erl_a),
                         clm_o, ini_o, blb_o)
 
+            blb_acc = (blb + (jnp.zeros((rows,), jnp.bool_),)
+                       if blb is not None else None)
             acc = (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
-                   erf_n, erc_n, erl_n, clm_n, ini_n, blb)
+                   erf_n, erc_n, erl_n, clm_n, ini_n, blb_acc)
             for j, br in enumerate(branches):
                 take = (do & in_range & (local == j))
                 if opts.dispatch_gating:
@@ -548,7 +564,10 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 else:
                     acc = _merge(br, take, acc)
             (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
-             erf_n, erc_n, erl_n, clm_n, ini_n, blb) = acc
+             erf_n, erc_n, erl_n, clm_n, ini_n, blb_acc) = acc
+            if blb_acc is not None:
+                blb = blb_acc[:7]
+                bused_c = bused_c + blb_acc[7].astype(jnp.int32)
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -575,7 +594,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                      jnp.where(erf_n, erl_n, errl),
                      used + spawned_here.astype(jnp.int32),
                      nproc + (do & in_range).astype(jnp.int32),
-                     nbad + (do & ~in_range).astype(jnp.int32), blb),
+                     nbad + (do & ~in_range).astype(jnp.int32), blb,
+                     bused_c),
                     (stgt, swrd, do, claims, inits))
 
         def busy_fn(_):
@@ -619,18 +639,17 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 blb0 = (blob["data"], blob["used"], blob["len"],
                         jnp.bool_(False), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0))
-                rblob_xs = blob["resv"]        # [batch, sites, rows]
             else:
                 blb0 = None
-                rblob_xs = None
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
                       z(jnp.int32), z(jnp.bool_), z(jnp.bool_),
                       z(jnp.bool_), z(jnp.int32), z(jnp.int32),
-                      z(jnp.int32), z(jnp.int32), z(jnp.int32), blb0)
+                      z(jnp.int32), z(jnp.int32), z(jnp.int32), blb0,
+                      z(jnp.int32))
             ((stf, _, ef, ec, sfail, dstr, errf, errc, errl, _used, nproc,
-              nbad, blbf),
+              nbad, blbf, _bused),
              (stgt, swrd, consumed, claims, inits)) = lax.scan(
-                scan_body, carry0, (msgs, valids, rblob_xs))
+                scan_body, carry0, (msgs, valids))
             # stgt [batch, ms, rows] → flat [e] with rows minor;
             # swrd [batch, ms, w1, rows] → [w1, e] planar.
             n_consumed = jnp.sum(consumed.astype(jnp.int32), axis=0)
@@ -1091,19 +1110,21 @@ def build_step(program: Program, opts: RuntimeOptions):
         nb_remote = jnp.int32(0)
 
         def cohort_blob_resv(ch):
-            """[batch, sites, rows] reserved global blob handles: each
-            runnable actor gets batch×sites disjoint windows into the
-            compacted free list (idle actors reserve nothing)."""
+            """[bd, sites, rows] reserved global blob handles: each
+            runnable actor gets blob_dispatches×sites disjoint windows
+            into the compacted free list (idle actors reserve nothing);
+            a used-counter walk hands one window to each dispatch that
+            actually allocates (the spawn_dispatches pattern)."""
             sites = ch.blob_sites
+            bd = ch.blob_dispatches
             if not sites:
-                return jnp.zeros((ch.batch, 0, ch.local_capacity),
-                                 jnp.int32)
+                return jnp.zeros((bd, 0, ch.local_capacity), jnp.int32)
             run_c = runnable[ch.local_start:ch.local_stop]
             rank = jnp.cumsum(run_c.astype(jnp.int32)) - 1
-            per = ch.batch * sites
+            per = bd * sites
             widx = jnp.where(run_c, rank * per, 0)
             idx = (ch.blob_offset + widx[None, None, :]
-                   + (jnp.arange(ch.batch, dtype=jnp.int32)
+                   + (jnp.arange(bd, dtype=jnp.int32)
                       * sites)[:, None, None]
                    + jnp.arange(sites, dtype=jnp.int32)[None, :, None])
             handles = jnp.take(free_blob, idx, mode="fill", fill_value=-1)

@@ -36,6 +36,13 @@ collected that round (conservative, always safe).
 Collection frees the slot (alive=False) — the row becomes claimable by
 ctx.spawn / Runtime.spawn. Sends to a collected actor dead-letter, which
 Pony's type system makes unrepresentable; here it is a counted drop.
+
+The same pass sweeps the device blob pool (≙ an actor's heap dying with
+the actor, mem/heap.c): a pool slot survives iff a surviving actor's
+Blob field holds its handle, a queued/spilled/injected message's Blob
+argument carries it, or the host owns it (blob_store not yet sent).
+Marking is shard-local by design — a handle moved off its owning shard
+is undereferenceable (v1 shard-local blobs) and is collected.
 """
 
 from __future__ import annotations
@@ -74,6 +81,28 @@ def _ref_fields(cohort):
             if is_ref(spec)]
 
 
+def build_blob_arg_mask(program: Program, msg_words: int) -> np.ndarray:
+    """Static [n_gids, msg_words] bool: which payload words of each
+    behaviour message are device blob handles (the Blob twin of
+    build_ref_arg_mask — ≙ gentrace.c tracing message object fields)."""
+    from ..ops.pack import is_blob, spec_width
+    n = len(program.behaviour_table)
+    mask = np.zeros((max(n, 1), msg_words), bool)
+    for gid, bdef in enumerate(program.behaviour_table):
+        off = 0
+        for spec in bdef.arg_specs:
+            if is_blob(spec) and off < msg_words:
+                mask[gid, off] = True
+            off += spec_width(spec)
+    return mask
+
+
+def _blob_fields(cohort):
+    from ..ops.pack import is_blob
+    return [f for f, spec in cohort.atype.field_specs.items()
+            if is_blob(spec)]
+
+
 def build_gc(program: Program, opts: RuntimeOptions):
     """Trace the collection pass; returns local_gc(state, extra_roots)
     → (state, (n_collected_total, converged, iters)) in per-shard
@@ -88,8 +117,20 @@ def build_gc(program: Program, opts: RuntimeOptions):
     any_ref_args = bool(ref_mask_np.any())
     n_gids = ref_mask_np.shape[0]
     max_iters = opts.gc_max_iters
+    bsl = opts.blob_slots
+    blob_mask_np = build_blob_arg_mask(program, opts.msg_words)
+    any_blob_args = bool(blob_mask_np.any())
+    # Sweep whenever the pool is live and ANY cohort can allocate or
+    # carry handles: a program whose handles never escape the allocating
+    # behaviour (no Blob fields/args) makes every unfreed blob garbage
+    # by construction — exactly what the sweep must reclaim.
+    sweep_blobs = bsl > 0 and (any_blob_args
+                               or any(_blob_fields(c)
+                                      for c in program.cohorts)
+                               or any(c.blob_sites
+                                      for c in program.cohorts))
 
-    def local_gc(st: RtState, extra_roots):
+    def local_gc(st: RtState, extra_roots, blob_roots):
         if p > 1:
             shard = lax.axis_index("actors").astype(jnp.int32)
         else:
@@ -136,9 +177,14 @@ def build_gc(program: Program, opts: RuntimeOptions):
         # each payload word that the static ref mask marks contributes a
         # [rows_c]-wide plane padded into an [nl] lane (targets are -1
         # outside the cohort's rows).
-        if any_ref_args:
-            mb_planes = []                                # [nl] each
+        # ONE walk serves both masks (ref args feed the actor trace,
+        # Blob args feed the blob sweep) — the ring-validity and gid
+        # computations are shared per (cohort, slot).
+        mb_planes = []                                    # [nl] each
+        mbb_planes = []                                   # blob handles
+        if any_ref_args or (sweep_blobs and any_blob_args):
             rmask = jnp.asarray(ref_mask_np)
+            bmask = jnp.asarray(blob_mask_np)
             for cohort in program.cohorts:
                 cbuf = st.buf[cohort.atype.__name__]
                 s0, s1 = cohort.local_start, cohort.local_stop
@@ -150,14 +196,17 @@ def build_gc(program: Program, opts: RuntimeOptions):
                     g = jnp.clip(gid, 0, n_gids - 1)
                     inr = valid & (gid >= 0) & (gid < n_gids)
                     for w in range(cbuf.shape[1] - 1):
-                        rm = rmask[g, w] & inr
-                        plane = jnp.full((nl,), -1, jnp.int32).at[
-                            s0 + jnp.arange(s1 - s0)].set(
-                            jnp.where(rm, cbuf[ci, 1 + w], -1))
-                        mb_planes.append(plane)
-            mb_tgt = jnp.stack(mb_planes) if mb_planes else None
-        else:
-            mb_tgt = None
+                        if any_ref_args:
+                            rm = rmask[g, w] & inr
+                            plane = jnp.full((nl,), -1, jnp.int32).at[
+                                s0 + jnp.arange(s1 - s0)].set(
+                                jnp.where(rm, cbuf[ci, 1 + w], -1))
+                            mb_planes.append(plane)
+                        if sweep_blobs and any_blob_args:
+                            bmm = bmask[g, w] & inr
+                            mbb_planes.append(
+                                jnp.where(bmm, cbuf[ci, 1 + w], -1))
+        mb_tgt = jnp.stack(mb_planes) if mb_planes else None
 
         def propagate(live):
             """One hop: mark every target referenced by a live source."""
@@ -203,6 +252,55 @@ def build_gc(program: Program, opts: RuntimeOptions):
         # --- collect (only on a converged trace; ≙ cycle.c `collect`) ---
         dead = st.alive & ~live & (rows < fh) & converged
         n_dead = jnp.sum(dead.astype(jnp.int32))
+
+        # --- blob sweep (≙ an actor's heap dying with it, gc.c/heap.c):
+        # a pool slot stays allocated iff a surviving actor's Blob FIELD
+        # holds it, a queued/spilled message's Blob ARG carries it, or
+        # the host declared it a root (rt.blob_store handles not yet
+        # sent). Marking is shard-LOCAL on purpose: handles are only
+        # dereferenceable on their owning shard (v1 shard-local blobs),
+        # so a handle that was moved off-shard — unreachable by
+        # construction — is collected here, closing that leak.
+        n_swept = jnp.int32(0)
+        blob_used2, blob_len2 = st.blob_used, st.blob_len
+        nbf2 = st.n_blob_free
+        if sweep_blobs:
+            bbase = shard * bsl
+            alive2 = st.alive & ~dead
+
+            def bmark(marks, handles, ok):
+                hl = handles - bbase
+                good = ok & (handles >= 0) & (hl >= 0) & (hl < bsl)
+                return marks.at[jnp.where(good, hl, bsl)].max(
+                    True, mode="drop")
+
+            bm = blob_roots
+            for cohort in program.device_cohorts:
+                s0, s1 = cohort.local_start, cohort.local_stop
+                for fname in _blob_fields(cohort):
+                    col = st.type_state[cohort.atype.__name__][fname]
+                    bm = bmark(bm, col.astype(jnp.int32), alive2[s0:s1])
+            if any_blob_args:
+                bmask2 = jnp.asarray(blob_mask_np)
+                for tgt_arr, words_arr in (
+                        (st.dspill_tgt, st.dspill_words),
+                        (st.rspill_tgt, st.rspill_words)):
+                    gid = words_arr[0]
+                    g = jnp.clip(gid, 0, n_gids - 1)
+                    inr = (gid >= 0) & (gid < n_gids) & (tgt_arr >= 0)
+                    for w in range(words_arr.shape[0] - 1):
+                        bm = bmark(bm, words_arr[1 + w],
+                                   bmask2[g, w] & inr)
+                # Queued-message handles: planes collected by the shared
+                # mailbox walk above (-1 where not a valid Blob arg).
+                for bplane in mbb_planes:
+                    bm = bmark(bm, bplane, bplane >= 0)
+            swept = st.blob_used & ~bm
+            n_swept = jnp.sum(swept.astype(jnp.int32))
+            blob_used2 = st.blob_used & bm
+            blob_len2 = jnp.where(swept, 0, st.blob_len)
+            nbf2 = st.n_blob_free + n_swept.reshape(1)
+
         st2 = RtState(
             buf=st.buf,
             head=jnp.where(dead, st.tail, st.head),
@@ -241,18 +339,18 @@ def build_gc(program: Program, opts: RuntimeOptions):
             # stale-high world bits cost one extra gather next tick and
             # the vote then corrects them.
             world_bits=st.world_bits,
-            # Blob pool passes through: v1 has no orphan sweep (an actor
-            # dying with unfreed blobs leaks them, visible via
-            # blobs_in_use — the documented explicit-free contract).
-            blob_data=st.blob_data, blob_used=st.blob_used,
-            blob_len=st.blob_len, blob_fail=st.blob_fail,
-            n_blob_alloc=st.n_blob_alloc, n_blob_free=st.n_blob_free,
+            # Blob pool: swept by the mark pass above (data words left in
+            # place — a freed slot zeroes on its next alloc).
+            blob_data=st.blob_data, blob_used=blob_used2,
+            blob_len=blob_len2, blob_fail=st.blob_fail,
+            n_blob_alloc=st.n_blob_alloc, n_blob_free=nbf2,
             n_blob_remote=st.n_blob_remote,
             type_state=st.type_state,
         )
         if p > 1:
             n_dead = lax.psum(n_dead, "actors")
-        return st2, (n_dead, converged, iters)
+            n_swept = lax.psum(n_swept, "actors")
+        return st2, (n_dead, converged, iters, n_swept)
 
     return local_gc
 
@@ -269,7 +367,7 @@ def jit_gc(program: Program, opts: RuntimeOptions, mesh=None):
     state_spec = state_partition_specs(program, opts)
     mapped = jax.shard_map(
         gc, mesh=mesh,
-        in_specs=(state_spec, sharded),
-        out_specs=(state_spec, (repl, repl, repl)),
+        in_specs=(state_spec, sharded, sharded),
+        out_specs=(state_spec, (repl, repl, repl, repl)),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,))

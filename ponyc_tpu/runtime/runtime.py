@@ -181,6 +181,9 @@ class Runtime:
         # messages, dispatched at host boundaries without touching the
         # device mailbox table (≙ inject_main, scheduler.c:179-190).
         self._host_fast_q: collections.deque = collections.deque()
+        # Device-pool blob handles the HOST currently owns (blob_store
+        # not yet sent/freed) — GC roots for the blob sweep (gc.py).
+        self._host_blobs: set = set()
         self._free: Dict[str, List[int]] = {}
         self._host_state: Dict[int, Dict[str, Any]] = {}
         self._exit_code = 0
@@ -426,6 +429,8 @@ class Runtime:
             self._gc_fn = gc_mod.jit_gc(self.program, self.opts, self.mesh)
             self._ref_mask = gc_mod.build_ref_arg_mask(
                 self.program, self.opts.msg_words)
+            self._blob_mask = gc_mod.build_blob_arg_mask(
+                self.program, self.opts.msg_words)
         # Host-side roots: refs in host-actor state dicts and in pending
         # inject messages (they will reach the device eventually).
         extra = np.zeros((self.program.total,), bool)
@@ -437,6 +442,11 @@ class Runtime:
                     if 0 <= v < self.program.total:
                         extra[v] = True
         import itertools
+        n_blob_total = self.program.shards * self.opts.blob_slots
+        blob_roots = np.zeros((n_blob_total,), bool)
+        for h in self._host_blobs:
+            if 0 <= h < n_blob_total:
+                blob_roots[h] = True
         for t, w in itertools.chain(self._inject_q, self._host_fast_q):
             if 0 <= t < self.program.total:
                 extra[t] = True
@@ -446,9 +456,13 @@ class Runtime:
                     v = int(w[1 + i])
                     if 0 <= v < self.program.total:
                         extra[v] = True
+                for i in np.nonzero(self._blob_mask[gid])[0]:
+                    v = int(w[1 + i])
+                    if 0 <= v < n_blob_total:
+                        blob_roots[v] = True
         before = self.counter("n_collected")
-        self.state, (n, converged, iters) = self._gc_fn(
-            self.state, jnp.asarray(extra))
+        self.state, (n, converged, iters, _n_swept) = self._gc_fn(
+            self.state, jnp.asarray(extra), jnp.asarray(blob_roots))
         self.totals["gc_runs"] += 1
         if not bool(converged):
             self.totals["gc_aborted"] += 1
@@ -562,6 +576,13 @@ class Runtime:
                 if (pack.cap_mode(spec) == "iso"
                         and not pack.is_blob(spec) and int(a) > 0):
                     heap.send_iso(int(a))
+        if self._host_blobs:
+            # A sent blob handle is MOVED off the host: it stops being a
+            # GC root here (the in-flight message keeps it alive until
+            # the receiver owns it — gc.py's mailbox/inject marks).
+            for spec, a in zip(behaviour_def.arg_specs, args):
+                if pack.is_blob(spec):
+                    self._host_blobs.discard(int(a))
         # Host senders (the API and host behaviours both run here) to
         # host targets take the fast lane; everything else rides the
         # device inject path. Per-sender-pair FIFO holds: a given
@@ -590,6 +611,14 @@ class Runtime:
         self._check_ref_args(behaviour_def.arg_specs, arg_cols,
                              f"{behaviour_def.actor_type.__name__}."
                              f"{behaviour_def.name}")
+        # Blob columns MOVE off the host exactly like send() args (the
+        # handles stop being GC roots; in-flight mailbox words keep the
+        # blobs alive until the receivers own them).
+        if self._host_blobs:
+            for spec, col in zip(behaviour_def.arg_specs, arg_cols):
+                if pack.is_blob(spec):
+                    for a in np.asarray(col).reshape(-1):
+                        self._host_blobs.discard(int(a))
         k = len(targets)
         words = np.zeros((k, 1 + self.opts.msg_words), np.int32)
         words[:, 0] = behaviour_def.global_id
@@ -1184,12 +1213,17 @@ class Runtime:
         ln = int(self._fetch(self.state.blob_len)[handle])
         return self._fetch(self.state.blob_data)[:ln, handle]
 
-    def blob_store(self, words, length: Optional[int] = None) -> int:
+    def blob_store(self, words, length: Optional[int] = None,
+                   near: Optional[int] = None) -> int:
         """Host-side blob allocation between steps (≙ the embedder
         building a message payload, pony.h pony_alloc_msg): claims a
         free pool slot, writes `words` (i32, ≤ blob_words), returns the
         handle — typically then sent as a Blob argument. The HOST owns
-        the blob until the send moves it."""
+        the blob until the send moves it.
+
+        `near`: an actor id whose SHARD should own the slot — on a mesh,
+        blobs are shard-local (v1), so allocate on the receiver's shard
+        or the handle arrives unreadable (null + n_blob_remote)."""
         if self.opts.blob_slots <= 0:
             raise RuntimeError("blob pool disabled: set "
                                "RuntimeOptions.blob_slots/blob_words")
@@ -1198,10 +1232,20 @@ class Runtime:
             raise ValueError(
                 f"{w.shape[0]} words > blob_words={self.opts.blob_words}")
         used = self._fetch(self.state.blob_used)
+        bsl = self.opts.blob_slots
+        if near is not None:
+            tgt_shard = int(near) // self.program.n_local
+            used = used[tgt_shard * bsl:(tgt_shard + 1) * bsl]
+            off = tgt_shard * bsl
+        else:
+            off = 0
         free = np.flatnonzero(~used)
         if free.size == 0:
-            raise BlobCapacityError("host blob_store: pool exhausted")
-        slot = int(free[0])
+            raise BlobCapacityError(
+                "host blob_store: pool exhausted"
+                + (f" on shard {near // self.program.n_local}"
+                   if near is not None else ""))
+        slot = off + int(free[0])
         full = np.zeros((self.opts.blob_words,), np.int32)
         full[:w.shape[0]] = w
         ln = w.shape[0] if length is None else int(length)
@@ -1216,6 +1260,7 @@ class Runtime:
             blob_used=st.blob_used.at[slot].set(True),
             blob_len=st.blob_len.at[slot].set(jnp.int32(ln)),
             n_blob_alloc=st.n_blob_alloc.at[shard].add(1))
+        self._host_blobs.add(slot)      # GC root until sent/freed
         return slot
 
     def blob_free_host(self, handle: int) -> None:
@@ -1232,11 +1277,13 @@ class Runtime:
             blob_used=st.blob_used.at[handle].set(False),
             blob_len=st.blob_len.at[handle].set(0),
             n_blob_free=st.n_blob_free.at[shard].add(1))
+        self._host_blobs.discard(handle)
 
     @property
     def blobs_in_use(self) -> int:
-        """Currently allocated pool slots (leak diagnostic: an actor that
-        dies without blob_free leaks its blobs — v1 has no orphan sweep)."""
+        """Currently allocated pool slots (leak diagnostic: orphaned
+        blobs — owner died, or handle moved off-shard — persist only
+        until the next rt.gc(), whose mark pass sweeps them)."""
         return int(self._fetch(self.state.blob_used).sum())
 
     def cohort_state(self, atype: ActorTypeMeta) -> Dict[str, np.ndarray]:

@@ -109,6 +109,10 @@ def save(rt, path: str) -> None:
         "steps_run": rt.steps_run,
         "exit_code": rt._exit_code,
         "noisy": rt._noisy,
+        # Host-owned device-blob handles (GC roots for the blob sweep):
+        # without them a restored world's first gc() would sweep blobs
+        # the host legitimately holds.
+        "host_blobs": sorted(rt._host_blobs),
     }
     buf = io.BytesIO()
     np.savez_compressed(buf, header=np.frombuffer(
@@ -164,6 +168,7 @@ def restore(rt, path: str) -> None:
                 rt._host_fast_q.append((int(ftgts[i]), fwords[i]))
     rt._free = {k: [int(x) for x in v] for k, v in header["free"].items()}
     rt._host_state = {int(k): v for k, v in header["host_state"].items()}
+    rt._host_blobs = set(int(h) for h in header.get("host_blobs", ()))
     rt.totals.clear()
     rt.totals.update(header["totals"])
     rt._last_counters = dict(header["last_counters"])

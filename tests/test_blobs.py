@@ -288,6 +288,63 @@ def test_host_free_rejects_double_free_and_bad_length():
         rt.blob_store([1], length=100)          # length > blob_words
 
 
+def test_stale_handle_reads_zero_not_leftovers():
+    # A freed slot keeps its words until the next alloc zeroes them; a
+    # stale/forged in-range handle must read 0, not the previous blob's
+    # payload (cross-actor data leak).
+    @actor
+    class Reader(Actor):
+        got: I32
+
+        @behaviour
+        def probe(self, st, k: Blob):
+            # k is a STALE handle: freed host-side after the send, so by
+            # dispatch time the slot is unallocated (words still there).
+            return {**st, "got": st["got"] + self.blob_get(k, 0)}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Reader, 2).start()
+    a = rt.spawn(Reader, got=0)
+    h = rt.blob_store([777])
+    rt.blob_free_host(h)                # slot free again, words remain
+    rt.send(a, Reader.probe, h)         # forged read of the freed slot
+    rt.run(max_steps=6)
+    assert rt.state_of(a)["got"] == 0   # used-gate: no leftover leak
+
+
+def test_blob_store_near_targets_receiver_shard():
+    opts = RuntimeOptions(**{**OPTS, "mesh_shards": 2})
+    rt = Runtime(opts)
+    rt.declare(Consumer, 4).start()
+    c_sh0 = rt.spawn(Consumer, total=0, seen=0)   # slot 0 → shard 0
+    c_sh1 = rt.spawn(Consumer, total=0, seen=0)   # slot 1 → shard 1
+    h0 = rt.blob_store([7, 7, 7, 7], near=int(c_sh0))
+    h1 = rt.blob_store([9, 9, 9, 9], near=int(c_sh1))
+    assert h0 // opts.blob_slots == 0
+    assert h1 // opts.blob_slots == 1             # receiver's shard
+    rt.send(int(c_sh0), Consumer.take, h0)
+    rt.send(int(c_sh1), Consumer.take, h1)
+    rt.run(max_steps=10)
+    assert rt.state_of(c_sh0)["total"] == 28
+    assert rt.state_of(c_sh1)["total"] == 36
+    assert rt.counter("n_blob_remote") == 0       # both landed local
+
+
+def test_snapshot_preserves_host_blob_roots():
+    from ponyc_tpu import serialise
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Consumer, 2).start()
+    h = rt.blob_store([5])
+    path = "/tmp/test_blob_snapshot.npz"
+    serialise.save(rt, path)
+    rt2 = Runtime(RuntimeOptions(**OPTS))
+    rt2.declare(Consumer, 2).start()
+    serialise.restore(rt2, path)
+    rt2.gc()                            # must NOT sweep the host's blob
+    assert rt2.blobs_in_use == 1
+    np.testing.assert_array_equal(rt2.blob_fetch(h), [5])
+
+
 def test_mesh_remote_handle_reads_null_and_counts():
     # 2-shard world: Producer on shard 0 allocates and sends to a
     # Consumer row on shard 1 — v1 blobs are shard-local, so the handle
@@ -312,6 +369,83 @@ def test_mesh_remote_handle_reads_null_and_counts():
     assert rt.state_of(c2)["total"] == 30 + 31 + 32 + 33
     assert rt.state_of(c2)["seen"] == 4
     assert rt.counter("n_blob_remote") == 1     # unchanged
-    assert rt.blobs_in_use == 1                 # the leaked remote blob:
+    assert rt.blobs_in_use == 1                 # the orphaned remote blob:
     # the handle was moved off-shard and nulled — nobody can free it
-    # (the documented v1 leak mode, visible to diagnostics)
+    # explicitly...
+    rt.gc()
+    assert rt.blobs_in_use == 0                 # ...but the GC mark pass
+    # sweeps it (shard-local marking: an off-shard handle marks nothing)
+
+
+def test_gc_sweeps_dead_actor_field_blobs():
+    # An actor holding a blob in a Blob FIELD dies unreachable → the
+    # next collection frees both the actor and its blob (≙ the actor's
+    # heap dying with it). A live holder keeps its blob alive.
+    @actor
+    class Holder(Actor):
+        stash: Blob
+        MAX_BLOBS = 1
+
+        @behaviour
+        def keep(self, st):
+            h = self.blob_alloc(length=2)
+            self.blob_set(h, 0, 9)
+            return {**st, "stash": h}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Holder, 4).start()
+    a = rt.spawn(Holder)
+    b = rt.spawn(Holder)
+    rt.send(a, Holder.keep)
+    rt.send(b, Holder.keep)
+    rt.run(max_steps=6)
+    assert rt.blobs_in_use == 2
+    assert rt.gc() == 0                 # both pinned (host refs) → live
+    assert rt.blobs_in_use == 2         # field-held blobs marked live
+    rt.release(b)                       # unpin: b becomes garbage
+    assert rt.gc() == 1
+    assert rt.blobs_in_use == 1         # b's blob swept with it
+    assert rt.counter("n_blob_free") == 1
+
+
+def test_blob_dispatches_bounds_reservation_footprint():
+    # Without the bound each runnable actor reserves batch×MAX_BLOBS
+    # windows, so 4 allocators × batch=2 would outsize a 4-slot pool
+    # even though only 4 slots get used; BLOB_DISPATCHES=1 shrinks the
+    # static window to 1 per actor and the same program fits exactly.
+    @actor
+    class Lean(Actor):
+        stash: Blob
+        MAX_BLOBS = 1
+        BLOB_DISPATCHES = 1
+
+        @behaviour
+        def fill(self, st, v: I32):
+            h = self.blob_alloc(length=1)
+            self.blob_set(h, 0, v)
+            return {**st, "stash": h}
+
+    rt = Runtime(RuntimeOptions(**{**OPTS, "blob_slots": 4}))
+    rt.declare(Lean, 4).start()
+    ids = [rt.spawn(Lean) for _ in range(4)]
+    for i, a in enumerate(ids):
+        rt.send(a, Lean.fill, i)
+    rt.run(max_steps=8)                 # must NOT raise BlobCapacityError
+    assert rt.blobs_in_use == 4
+    assert sorted(int(rt.blob_fetch(int(rt.state_of(a)["stash"]))[0])
+                  for a in ids) == [0, 1, 2, 3]
+
+
+def test_gc_keeps_host_held_and_inflight_blobs():
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Consumer, 2).start()
+    c = rt.spawn(Consumer, total=0, seen=0)
+    h_held = rt.blob_store([1])         # host-owned root
+    h_sent = rt.blob_store([2, 3, 4, 5])
+    rt.send(c, Consumer.take, h_sent)   # in-flight (inject queue)
+    rt.gc()                             # must sweep NEITHER
+    assert rt.blobs_in_use == 2
+    rt.run(max_steps=8)                 # take() frees h_sent
+    assert rt.blobs_in_use == 1
+    rt.blob_free_host(h_held)
+    assert rt.blobs_in_use == 0
