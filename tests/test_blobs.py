@@ -345,6 +345,17 @@ def test_snapshot_preserves_host_blob_roots():
     np.testing.assert_array_equal(rt2.blob_fetch(h), [5])
 
 
+def test_records_model_oracle():
+    # The records pipeline (models/records.py): variable-length blob
+    # payloads through source → worker → fan-in sink, word-for-word
+    # against the NumPy oracle, every blob freed by its consumer.
+    from ponyc_tpu.models import records
+    rt, st = records.run_records(n_sources=8, n_records=6)
+    assert st["n"] == 48
+    assert rt.counter("n_blob_alloc") == 48
+    assert rt.counter("n_blob_free") == 48
+
+
 def test_mesh_remote_handle_reads_null_and_counts():
     # 2-shard world: Producer on shard 0 allocates and sends to a
     # Consumer row on shard 1 — v1 blobs are shard-local, so the handle
