@@ -278,14 +278,15 @@ class BlobPoolView:
     ≙ the reference's actor heap + pony_alloc_msg payloads
     (pony.h:332-360): alloc on the owning actor, move by message."""
 
-    __slots__ = ("data", "used", "len_", "base", "nslots", "take",
+    __slots__ = ("data", "used", "len_", "gen", "base", "nslots", "take",
                  "resv", "claims", "fail", "n_alloc", "n_free",
                  "n_remote", "alloced")
 
-    def __init__(self, data, used, len_, base, take, resv):
+    def __init__(self, data, used, len_, gen, base, take, resv):
         self.data = data            # [W, B] i32 (working copy)
         self.used = used            # [B] bool
         self.len_ = len_            # [B] i32
+        self.gen = gen              # [B] i32 slot generations (ABA guard)
         self.base = base            # traced i32: this shard's first handle
         self.nslots = used.shape[0]
         self.take = take            # [lanes] bool
@@ -299,13 +300,19 @@ class BlobPoolView:
         #   (drives the engine's blob_dispatches used-counter walk)
 
     def local(self, h):
-        """(local slot index, validity mask). Invalid handles map to the
-        UPPER sentinel `nslots` — JAX normalises negative indices
-        NumPy-style even under mode="drop"/"fill", so -1 would silently
-        address the last slot; an out-of-range-high index is what those
-        modes actually drop/fill."""
-        hl = h - self.base
+        """(local slot index, validity mask). The handle's generation
+        bits must match the slot's current generation (ABA guard: a
+        stale handle to a recycled slot is dead, ops.pack encoding).
+        Invalid handles map to the UPPER sentinel `nslots` — JAX
+        normalises negative indices NumPy-style even under
+        mode="drop"/"fill", so -1 would silently address the last slot;
+        an out-of-range-high index is what those modes actually
+        drop/fill."""
+        hl = pack.blob_slot(h) - self.base
         ok = (h >= 0) & (hl >= 0) & (hl < self.nslots)
+        hs = jnp.where(ok, hl, self.nslots)
+        ok = ok & (jnp.take(self.gen, hs, mode="fill", fill_value=-1)
+                   == pack.blob_gen_of(h))
         return jnp.where(ok, hl, self.nslots), ok
 
 
@@ -667,12 +674,19 @@ class Context:
             raise RuntimeError(
                 f"more than MAX_BLOBS={b.resv.shape[0]} blob_alloc calls "
                 "in one behaviour dispatch; raise the declared budget")
-        h = b.resv[b.claims]
+        slot = b.resv[b.claims]                # reserved global SLOT ids
         b.claims += 1
         w = jnp.asarray(when, jnp.bool_)
-        ok = w & b.take & (h >= 0)
-        b.fail = b.fail | jnp.any(w & b.take & (h < 0))
-        idx = jnp.where(ok, h - b.base, b.nslots)   # OOB-high → dropped
+        ok = w & b.take & (slot >= 0)
+        b.fail = b.fail | jnp.any(w & b.take & (slot < 0))
+        idx = jnp.where(ok, slot - b.base, b.nslots)  # OOB-high → dropped
+        # Bump the slot generation and bake it into the handle (ABA
+        # guard): any still-circulating handle from the slot's previous
+        # life now mismatches and reads null.
+        newgen = (jnp.take(b.gen, idx, mode="fill", fill_value=0)
+                  + 1) & pack.BLOB_GEN_MASK
+        b.gen = b.gen.at[idx].set(newgen, mode="drop")
+        h = pack.blob_handle(slot, newgen)
         b.used = b.used.at[idx].set(True, mode="drop")
         wpool = b.data.shape[0]
         ln = (jnp.int32(wpool) if length is None

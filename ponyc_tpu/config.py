@@ -182,6 +182,11 @@ class RuntimeOptions:
             raise ValueError(
                 "blob_slots and blob_words enable the blob pool together "
                 "(both > 0) or not at all (both 0)")
+        if self.blob_slots * max(1, self.mesh_shards) >= 1 << 20:
+            raise ValueError(
+                "shards x blob_slots must stay below 2^20 (handle "
+                "encoding reserves the high bits for the slot "
+                "generation; ops/pack.py BLOB_GEN_SHIFT)")
 
     @property
     def overload_occ(self) -> int:

@@ -282,6 +282,30 @@ def null_word(ann) -> int:
     handles (0 is a real id for both), 0 otherwise."""
     return -1 if (is_ref(ann) or is_blob(ann)) else 0
 
+
+# Blob handle encoding: low bits = global pool slot, high bits = the
+# slot's GENERATION at alloc time (state.blob_gen, bumped per alloc).
+# A handle whose generation mismatches its slot's current one is dead —
+# a stale/forged reference to a recycled slot reads null instead of the
+# new owner's words (ABA protection; wraps after 2^10 reuses, so a
+# handle held across exactly k*1024 reuses of its slot could
+# false-validate — documented, not defended). Works on np and jnp ints;
+# -1 decodes to an out-of-range slot, so null handles stay invalid.
+BLOB_GEN_SHIFT = 20          # pool addressing: shards*blob_slots < 2^20
+BLOB_GEN_MASK = 0x3FF        # 10 generation bits
+
+
+def blob_slot(h):
+    return h & ((1 << BLOB_GEN_SHIFT) - 1)
+
+
+def blob_gen_of(h):
+    return (h >> BLOB_GEN_SHIFT) & BLOB_GEN_MASK
+
+
+def blob_handle(slot, gen):
+    return ((gen & BLOB_GEN_MASK) << BLOB_GEN_SHIFT) | slot
+
 # ≙ TK_CAP_SEND {iso, val, tag} (type/cap.c:90): the caps a value may
 # carry across an actor boundary.
 SENDABLE_CAPS = frozenset(("iso", "val", "tag"))

@@ -129,7 +129,9 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
         for spec, a in zip(bdef.arg_specs, args):
             if pack.is_blob(spec):
                 a = jnp.asarray(a, jnp.int32)
-                local_ok = (a >= blob.base) & (a < blob.base + blob.nslots)
+                slot = pack.blob_slot(a)
+                local_ok = ((a >= 0) & (slot >= blob.base)
+                            & (slot < blob.base + blob.nslots))
                 remote = (a >= 0) & ~local_ok
                 blob.n_remote = blob.n_remote + jnp.sum(
                     (remote & blob.take).astype(jnp.int32))
@@ -279,8 +281,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
             # see api.BlobPoolView for why no cross-branch select is
             # needed; resv row may be zero-sites for receive-only types.)
             from ..api import BlobPoolView
-            bdata, bused, blen, bbase, bresv = blob_in
-            bv = BlobPoolView(bdata, bused, blen, bbase,
+            bdata, bused, blen, bgen, bbase, bresv = blob_in
+            bv = BlobPoolView(bdata, bused, blen, bgen, bbase,
                               (take if take is not None
                                else jnp.ones((lanes,), jnp.bool_)),
                               bresv if (bresv is not None
@@ -325,7 +327,7 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
         b = jnp.bool_
         blob_out = None
         if bv is not None:
-            blob_out = (bv.data, bv.used, bv.len_, bv.fail,
+            blob_out = (bv.data, bv.used, bv.len_, bv.gen, bv.fail,
                         bv.n_alloc, bv.n_free, bv.n_remote,
                         _bcast_lanes(bv.alloced, jnp.bool_, lanes))
         return (st2, (tgts, words),
@@ -505,16 +507,16 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                  erf_a, erc_a, erl_a, clm_a, ini_a, blb_a) = acc
                 blob_in = None
                 if blb_a is not None:
-                    d_a, u_a, l_a = blb_a[0], blb_a[1], blb_a[2]
-                    blob_in = (d_a, u_a, l_a, blob["base"], rblob)
+                    blob_in = (blb_a[0], blb_a[1], blb_a[2], blb_a[3],
+                               blob["base"], rblob)
                 (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf,
                  bds, (berf, berc, berl), bl_o) = br(
                     st, msg[1:], ids, resv_k, blob_in, take)
                 if blb_a is not None:
-                    blb_o = (bl_o[0], bl_o[1], bl_o[2],
-                             blb_a[3] | bl_o[3], blb_a[4] + bl_o[4],
-                             blb_a[5] + bl_o[5], blb_a[6] + bl_o[6],
-                             blb_a[7] | bl_o[7])
+                    blb_o = (bl_o[0], bl_o[1], bl_o[2], bl_o[3],
+                             blb_a[4] | bl_o[4], blb_a[5] + bl_o[5],
+                             blb_a[6] + bl_o[6], blb_a[7] + bl_o[7],
+                             blb_a[8] | bl_o[8])
                 else:
                     blb_o = None
                 st_o = {k: jnp.where(take, st2[k], st_a[k]) for k in st_a}
@@ -566,8 +568,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
              erf_n, erc_n, erl_n, clm_n, ini_n, blb_acc) = acc
             if blb_acc is not None:
-                blb = blb_acc[:7]
-                bused_c = bused_c + blb_acc[7].astype(jnp.int32)
+                blb = blb_acc[:8]
+                bused_c = bused_c + blb_acc[8].astype(jnp.int32)
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -637,8 +639,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             z = lambda d: jnp.zeros((rows,), d)         # noqa: E731
             if use_blob:
                 blb0 = (blob["data"], blob["used"], blob["len"],
-                        jnp.bool_(False), jnp.int32(0), jnp.int32(0),
-                        jnp.int32(0))
+                        blob["gen"], jnp.bool_(False), jnp.int32(0),
+                        jnp.int32(0), jnp.int32(0))
             else:
                 blb0 = None
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
@@ -671,8 +673,9 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             # queued runnable messages skips gather/dispatch/outbox
             # entirely — one reduction decides.
             blb_idle = ((blob["data"], blob["used"], blob["len"],
-                         jnp.bool_(False), jnp.int32(0), jnp.int32(0),
-                         jnp.int32(0)) if use_blob else None)
+                         blob["gen"], jnp.bool_(False), jnp.int32(0),
+                         jnp.int32(0), jnp.int32(0))
+                        if use_blob else None)
             return (type_state_rows,
                     jnp.full((e,), -1, jnp.int32),
                     jnp.zeros((w1, e), jnp.int32),
@@ -1103,7 +1106,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             bperm, bvfree, _ = compact_mask(~st.blob_used, bsl)
             free_blob = jnp.where(bvfree, bbase + bperm.astype(jnp.int32),
                                   jnp.int32(-1))
-        blob_cur = (st.blob_data, st.blob_used, st.blob_len)
+        blob_cur = (st.blob_data, st.blob_used, st.blob_len, st.blob_gen)
         blob_fail = st.blob_fail[0]
         nb_alloc = jnp.int32(0)
         nb_free = jnp.int32(0)
@@ -1148,8 +1151,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
             if blob_en and ch.uses_blobs:
                 blobd = {"data": blob_cur[0], "used": blob_cur[1],
-                         "len": blob_cur[2], "base": bbase,
-                         "resv": cohort_blob_resv(ch)}
+                         "len": blob_cur[2], "gen": blob_cur[3],
+                         "base": bbase, "resv": cohort_blob_resv(ch)}
             else:
                 blobd = None
             (stf, out, new_head_rows, ef, ec, nproc, nbad, claims, inits,
@@ -1158,11 +1161,11 @@ def build_step(program: Program, opts: RuntimeOptions):
                 st.buf[ch.atype.__name__], st.head[s0:s1], occ0[s0:s1],
                 runnable[s0:s1], ids, cohort_resv(ch), blob=blobd)
             if blob_out is not None:
-                blob_cur = (blob_out[0], blob_out[1], blob_out[2])
-                blob_fail = blob_fail | blob_out[3]
-                nb_alloc = nb_alloc + blob_out[4]
-                nb_free = nb_free + blob_out[5]
-                nb_remote = nb_remote + blob_out[6]
+                blob_cur = blob_out[:4]
+                blob_fail = blob_fail | blob_out[4]
+                nb_alloc = nb_alloc + blob_out[5]
+                nb_free = nb_free + blob_out[6]
+                nb_remote = nb_remote + blob_out[7]
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
             out_entries.append(out)
@@ -1542,7 +1545,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             plan_bounds=res.plan_bounds,
             world_bits=vec(wb_new),
             blob_data=blob_cur[0], blob_used=blob_cur[1],
-            blob_len=blob_cur[2],
+            blob_len=blob_cur[2], blob_gen=blob_cur[3],
             blob_fail=vec(blob_fail, jnp.bool_),
             n_blob_alloc=vec(st.n_blob_alloc[0] + nb_alloc),
             n_blob_free=vec(st.n_blob_free[0] + nb_free),

@@ -268,9 +268,18 @@ def build_gc(program: Program, opts: RuntimeOptions):
             bbase = shard * bsl
             alive2 = st.alive & ~dead
 
+            from ..ops import pack as _pk
+
             def bmark(marks, handles, ok):
-                hl = handles - bbase
+                """Mark gen-MATCHING local handles only: a stale handle
+                to a recycled slot is dead and must not keep the new
+                occupant alive (ops.pack handle encoding)."""
+                hl = _pk.blob_slot(handles) - bbase
                 good = ok & (handles >= 0) & (hl >= 0) & (hl < bsl)
+                hs = jnp.where(good, hl, bsl)
+                good = good & (jnp.take(st.blob_gen, hs, mode="fill",
+                                        fill_value=-1)
+                               == _pk.blob_gen_of(handles))
                 return marks.at[jnp.where(good, hl, bsl)].max(
                     True, mode="drop")
 
@@ -342,7 +351,8 @@ def build_gc(program: Program, opts: RuntimeOptions):
             # Blob pool: swept by the mark pass above (data words left in
             # place — a freed slot zeroes on its next alloc).
             blob_data=st.blob_data, blob_used=blob_used2,
-            blob_len=blob_len2, blob_fail=st.blob_fail,
+            blob_len=blob_len2, blob_gen=st.blob_gen,
+            blob_fail=st.blob_fail,
             n_blob_alloc=st.n_blob_alloc, n_blob_free=nbf2,
             n_blob_remote=st.n_blob_remote,
             type_state=st.type_state,

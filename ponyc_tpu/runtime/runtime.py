@@ -445,8 +445,9 @@ class Runtime:
         n_blob_total = self.program.shards * self.opts.blob_slots
         blob_roots = np.zeros((n_blob_total,), bool)
         for h in self._host_blobs:
-            if 0 <= h < n_blob_total:
-                blob_roots[h] = True
+            slot = pack.blob_slot(int(h))
+            if h >= 0 and 0 <= slot < n_blob_total:
+                blob_roots[slot] = True
         for t, w in itertools.chain(self._inject_q, self._host_fast_q):
             if 0 <= t < self.program.total:
                 extra[t] = True
@@ -458,8 +459,9 @@ class Runtime:
                         extra[v] = True
                 for i in np.nonzero(self._blob_mask[gid])[0]:
                     v = int(w[1 + i])
-                    if 0 <= v < n_blob_total:
-                        blob_roots[v] = True
+                    slot = pack.blob_slot(v)
+                    if v >= 0 and 0 <= slot < n_blob_total:
+                        blob_roots[slot] = True
         before = self.counter("n_collected")
         self.state, (n, converged, iters, _n_swept) = self._gc_fn(
             self.state, jnp.asarray(extra), jnp.asarray(blob_roots))
@@ -1201,17 +1203,29 @@ class Runtime:
                     else self._fetch(v)[col].item())
                 for k, v in ts.items()}
 
+    def _blob_slot_of(self, handle: int, what: str) -> int:
+        """Decode + validate a handle host-side (range, allocation,
+        generation — a stale handle to a recycled slot rejects)."""
+        bsl = self.opts.blob_slots
+        slot = pack.blob_slot(int(handle))
+        if handle < 0 or not (0 <= slot < self.program.shards * bsl):
+            raise IndexError(f"{what}: blob handle {handle} out of range")
+        if not bool(self._fetch(self.state.blob_used)[slot]):
+            raise KeyError(f"{what}: blob handle {handle} is not "
+                           "allocated")
+        if (int(self._fetch(self.state.blob_gen)[slot])
+                & pack.BLOB_GEN_MASK) != pack.blob_gen_of(int(handle)):
+            raise KeyError(f"{what}: blob handle {handle} is STALE — "
+                           "its slot was recycled (generation mismatch)")
+        return slot
+
     def blob_fetch(self, handle: int) -> np.ndarray:
         """Host-side read of a device blob's logical words (≙ receiving
         a message payload on the main-thread scheduler). Raises on null/
-        unallocated handles."""
-        bsl = self.opts.blob_slots
-        if not (0 <= handle < self.program.shards * bsl):
-            raise IndexError(f"blob handle {handle} out of range")
-        if not bool(self._fetch(self.state.blob_used)[handle]):
-            raise KeyError(f"blob handle {handle} is not allocated")
-        ln = int(self._fetch(self.state.blob_len)[handle])
-        return self._fetch(self.state.blob_data)[:ln, handle]
+        unallocated/stale handles."""
+        slot = self._blob_slot_of(handle, "blob_fetch")
+        ln = int(self._fetch(self.state.blob_len)[slot])
+        return self._fetch(self.state.blob_data)[:ln, slot]
 
     def blob_store(self, words, length: Optional[int] = None,
                    near: Optional[int] = None) -> int:
@@ -1255,29 +1269,30 @@ class Runtime:
                 f"{self.opts.blob_words}]")
         shard = slot // self.opts.blob_slots
         st = self.state
+        gen = (int(self._fetch(st.blob_gen)[slot]) + 1) \
+            & pack.BLOB_GEN_MASK
         self.state = self._replace(
             blob_data=st.blob_data.at[:, slot].set(jnp.asarray(full)),
             blob_used=st.blob_used.at[slot].set(True),
             blob_len=st.blob_len.at[slot].set(jnp.int32(ln)),
+            blob_gen=st.blob_gen.at[slot].set(jnp.int32(gen)),
             n_blob_alloc=st.n_blob_alloc.at[shard].add(1))
-        self._host_blobs.add(slot)      # GC root until sent/freed
-        return slot
+        handle = pack.blob_handle(slot, gen)
+        self._host_blobs.add(handle)    # GC root until sent/freed
+        return handle
 
     def blob_free_host(self, handle: int) -> None:
         """Host-side release of a blob the host owns (e.g. fetched and
-        finished with). Double frees reject (counter integrity)."""
-        bsl = self.opts.blob_slots
-        if not (0 <= handle < self.program.shards * bsl):
-            raise IndexError(f"blob handle {handle} out of range")
-        if not bool(self._fetch(self.state.blob_used)[handle]):
-            raise KeyError(f"blob handle {handle} is not allocated")
-        shard = handle // bsl
+        finished with). Double frees and stale handles reject (counter
+        integrity + ABA guard)."""
+        slot = self._blob_slot_of(handle, "blob_free_host")
+        shard = slot // self.opts.blob_slots
         st = self.state
         self.state = self._replace(
-            blob_used=st.blob_used.at[handle].set(False),
-            blob_len=st.blob_len.at[handle].set(0),
+            blob_used=st.blob_used.at[slot].set(False),
+            blob_len=st.blob_len.at[slot].set(0),
             n_blob_free=st.n_blob_free.at[shard].add(1))
-        self._host_blobs.discard(handle)
+        self._host_blobs.discard(int(handle))
 
     @property
     def blobs_in_use(self) -> int:

@@ -192,6 +192,11 @@ class RtState:
     blob_data: jnp.ndarray    # [blob_words, P*BS] int32 payload words
     blob_used: jnp.ndarray    # [P*BS] bool — slot allocated
     blob_len: jnp.ndarray     # [P*BS] int32 — logical word count
+    blob_gen: jnp.ndarray     # [P*BS] int32 — slot generation, bumped on
+    #   each alloc and carried in the HANDLE's high bits (ops.pack
+    #   BLOB_GEN_SHIFT): a stale handle to a recycled slot mismatches
+    #   and reads null — ABA protection for the iso discipline's
+    #   dynamic escape hatches (forged ints, post-sweep stragglers)
     blob_fail: jnp.ndarray    # [P] bool — sticky: an alloc found no slot
     n_blob_alloc: jnp.ndarray   # [P] int32 — lifetime allocs
     n_blob_free: jnp.ndarray    # [P] int32 — lifetime frees
@@ -285,6 +290,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         blob_data=jnp.zeros((opts.blob_words, p * opts.blob_slots), i32),
         blob_used=jnp.zeros((p * opts.blob_slots,), jnp.bool_),
         blob_len=jnp.zeros((p * opts.blob_slots,), i32),
+        blob_gen=jnp.zeros((p * opts.blob_slots,), i32),
         blob_fail=jnp.zeros((p,), jnp.bool_),
         n_blob_alloc=jnp.zeros((p,), i32),
         n_blob_free=jnp.zeros((p,), i32),

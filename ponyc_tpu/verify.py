@@ -124,7 +124,8 @@ def behaviour_effects(bdef: BehaviourDef,
         mb = int(getattr(atype, "MAX_BLOBS", 0) or 0)
         bv = BlobPoolView(
             jnp.zeros((1, 1), jnp.int32), jnp.zeros((1,), jnp.bool_),
-            jnp.zeros((1,), jnp.int32), jnp.int32(0), jnp.bool_(True),
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+            jnp.int32(0), jnp.bool_(True),
             jnp.full((mb,), -1, jnp.int32) if mb else None)
         ctx = _ProbeContext(jnp.int32(0), msg_words, spawn_resv=resv,
                             spawn_meta={t: {} for t in spawn_budget},

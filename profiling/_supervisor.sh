@@ -8,7 +8,20 @@ LOG=/tmp/p9_campaign.log
 while true; do
   now=$(date +%s)
   [ "$now" -ge "$DEADLINE" ] && { echo "[$(date -u +%H:%M:%S)] deadline, supervisor exit" >> "$LOG"; break; }
-  grep -q "ALL_DONE" /tmp/p9_results.txt 2>/dev/null && { echo "[$(date -u +%H:%M:%S)] ALL_DONE, supervisor exit" >> "$LOG"; break; }
+  if grep -q "ALL_DONE" /tmp/p9_results.txt 2>/dev/null; then
+    # Chain the second campaign (pallas_fused × pings × gating × cap)
+    # once the primary A/B has fully landed; then exit.
+    if grep -q "FUSED_DONE" /tmp/p9_results.txt 2>/dev/null; then
+      echo "[$(date -u +%H:%M:%S)] ALL_DONE+FUSED_DONE, supervisor exit" >> "$LOG"
+      break
+    fi
+    # (launch is synchronous — one attempt at a time, like the primary)
+    echo "[$(date -u +%H:%M:%S)] launching _profile_fused.py" >> "$LOG"
+    python -u /root/repo/profiling/_profile_fused.py >> /tmp/p9_fused.log 2>&1
+    echo "[$(date -u +%H:%M:%S)] fused attempt exited rc=$?" >> "$LOG"
+    sleep 60
+    continue
+  fi
   if ! pgrep -f "_profile_all.py" > /dev/null; then
     echo "[$(date -u +%H:%M:%S)] launching _profile_all.py" >> "$LOG"
     python -u /root/repo/profiling/_profile_all.py >> /tmp/p9_all.log 2>&1

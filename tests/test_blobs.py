@@ -312,16 +312,47 @@ def test_stale_handle_reads_zero_not_leftovers():
     assert rt.state_of(a)["got"] == 0   # used-gate: no leftover leak
 
 
+def test_recycled_slot_stale_handle_reads_zero():
+    # ABA guard: free a blob, let the SLOT be re-allocated to a new
+    # owner, then read through the old handle — generation mismatch
+    # must yield 0, never the new owner's words. (The used-gate alone
+    # cannot catch this: the slot IS allocated, just not to you.)
+    @actor
+    class Reader(Actor):
+        got: I32
+
+        @behaviour
+        def probe(self, st, h: Blob):
+            return {**st, "got": st["got"] + self.blob_get(h, 0)}
+
+    rt = Runtime(RuntimeOptions(**{**OPTS, "blob_slots": 1}))
+    rt.declare(Reader, 2).start()
+    a = rt.spawn(Reader, got=0)
+    h_old = rt.blob_store([111])
+    rt.blob_free_host(h_old)
+    h_new = rt.blob_store([222])        # 1-slot pool: SAME slot, new gen
+    from ponyc_tpu.ops import pack
+    assert pack.blob_slot(h_old) == pack.blob_slot(h_new)
+    assert h_old != h_new               # generations differ
+    rt.send(a, Reader.probe, h_old)     # stale handle
+    rt.run(max_steps=6)
+    assert rt.state_of(a)["got"] == 0   # gen mismatch → null read
+    with pytest.raises(KeyError, match="STALE"):
+        rt.blob_fetch(h_old)            # host side rejects it too
+    np.testing.assert_array_equal(rt.blob_fetch(h_new), [222])
+
+
 def test_blob_store_near_targets_receiver_shard():
     opts = RuntimeOptions(**{**OPTS, "mesh_shards": 2})
     rt = Runtime(opts)
     rt.declare(Consumer, 4).start()
     c_sh0 = rt.spawn(Consumer, total=0, seen=0)   # slot 0 → shard 0
     c_sh1 = rt.spawn(Consumer, total=0, seen=0)   # slot 1 → shard 1
+    from ponyc_tpu.ops import pack
     h0 = rt.blob_store([7, 7, 7, 7], near=int(c_sh0))
     h1 = rt.blob_store([9, 9, 9, 9], near=int(c_sh1))
-    assert h0 // opts.blob_slots == 0
-    assert h1 // opts.blob_slots == 1             # receiver's shard
+    assert pack.blob_slot(h0) // opts.blob_slots == 0
+    assert pack.blob_slot(h1) // opts.blob_slots == 1   # receiver's shard
     rt.send(int(c_sh0), Consumer.take, h0)
     rt.send(int(c_sh1), Consumer.take, h1)
     rt.run(max_steps=10)
