@@ -152,10 +152,13 @@ class RuntimeOptions:
     # words live device-resident in a [blob_words, shards*blob_slots]
     # pool, so payloads larger than msg_words never round-trip the
     # host. 0 = disabled (all blob plumbing compiles away). ---
-    blob_slots: int = 0            # pool slots PER SHARD; handles are
-    #   global ids (shard * blob_slots + slot); v1 blobs are shard-local:
-    #   a handle delivered to another shard's actor reads as the null
-    #   handle -1 and counts in rt.counter("n_blob_remote")
+    blob_slots: int = 0            # pool slots PER SHARD; handles carry
+    #   (generation, global slot id) — ops/pack.py encoding. On a mesh a
+    #   blob MIGRATES with its routed message (engine._route); host
+    #   injections bypass routing, so host payloads should allocate on
+    #   the receiver's shard (Runtime.blob_store(near=...)) — an
+    #   undereferenceable arrival reads null and counts in
+    #   rt.counter("n_blob_remote")
     blob_words: int = 0            # i32 words per blob slot (the pool's
     #   uniform width; ctx.blob_alloc records each blob's logical length)
 

@@ -714,16 +714,33 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
 
 def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
            rspill_cap: int, overload_occ, head, tail, shard_base,
-           mute_slots: int, pressured_global, pressured_local):
+           mute_slots: int, pressured_global, pressured_local,
+           blob=None):
     """Mesh routing: pack entries into per-destination-shard buckets and
     exchange them with one all_to_all over the actor axis (ICI).
 
     Returns (received Entries [shards*bucket], new route-spill, spill count,
-    overflow flag, newly muted [n_local], their refs). Bucket overflow keeps
-    messages on the source shard (route-spill, retried first next step) and
-    mutes the sender — backpressure across the mesh without any
-    receiver-side state (≙ the intent of ponyint_maybe_mute; the occupancy
-    signal here is "the link to that shard is saturated").
+    overflow flag, newly muted [n_local], their refs[, blob results]).
+    Bucket overflow keeps messages on the source shard (route-spill,
+    retried first next step) and mutes the sender — backpressure across
+    the mesh without any receiver-side state (≙ the intent of
+    ponyint_maybe_mute; the occupancy signal here is "the link to that
+    shard is saturated").
+
+    Blob MIGRATION (`blob` = dict(data, used, len, gen, bbase, bsl,
+    shard, mask) when the program routes Blob args on a mesh): a blob
+    rides its message across the ICI — per blob-arg word position, a
+    length row + the payload words concatenate onto the exchanged
+    words; the source shard frees the shipped slot, the receiving shard
+    allocates a fresh local slot (new generation) and rewrites the
+    handle word before delivery. Same-shard bucket blocks skip
+    migration (the handle is already dereferenceable). A receive-side
+    pool-full drop delivers the message with a null handle and counts
+    in n_blob_remote — backpressure-safe data loss made visible, never
+    corruption. Route-spilled entries keep their (still-local) blobs
+    and migrate when the retry actually ships. ≙ nothing in the
+    reference — libponyrt is single-node; this is the distributed half
+    of pony_alloc_msg payload movement.
     """
     tgt, sender, words = entries
     e = tgt.shape[0]
@@ -750,12 +767,106 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
     fill_f = fill.reshape(shards * bucket)
     bw = jnp.where(fill_f[None, :], ws[:, src.reshape(-1)], 0)
 
+    blob_out = None
+    if blob is not None:
+        # --- migration, source side: for every blob-carrying bucketed
+        # entry bound OFF-shard, append (len, payload...) rows and free
+        # the local slot. Positions are static (the Blob-arg mask).
+        bdata, bused, blen, bgen = (blob["data"], blob["used"],
+                                    blob["len"], blob["gen"])
+        bbase, bsl = blob["bbase"], blob["bsl"]
+        mask_np = blob["mask"]                   # STATIC numpy mask
+        mask = jnp.asarray(mask_np)
+        wb = bdata.shape[0]
+        n_gids = mask.shape[0]
+        sb = shards * bucket
+        gid = bw[0]
+        g = jnp.clip(gid, 0, n_gids - 1)
+        gid_ok = fill_f & (gid >= 0) & (gid < n_gids)
+        # Off-shard only: bucket block s goes to shard s.
+        off_shard = jnp.broadcast_to(
+            (jnp.arange(shards, dtype=jnp.int32)[:, None]
+             != blob["shard"]), (shards, bucket)).reshape(sb)
+        extra_rows = []
+        freed = jnp.zeros((bsl,), jnp.bool_)
+        positions = [w for w in range(mask_np.shape[1])
+                     if bool(mask_np[:, w].any())]
+        for wpos in positions:
+            h = bw[1 + wpos]
+            hl = pack.blob_slot(h) - bbase
+            hs = jnp.where((hl >= 0) & (hl < bsl), hl, bsl)
+            okh = (gid_ok & off_shard & mask[g, wpos] & (h >= 0)
+                   & (hs < bsl)
+                   & (jnp.take(bgen, hs, mode="fill", fill_value=-1)
+                      == pack.blob_gen_of(h))
+                   & jnp.take(bused, hs, mode="fill", fill_value=False))
+            hx = jnp.where(okh, hl, bsl)
+            extra_rows.append(jnp.where(
+                okh, jnp.take(blen, hx, mode="fill", fill_value=0),
+                jnp.int32(-1))[None, :])             # -1 = no payload
+            extra_rows.append(jnp.where(
+                okh[None, :],
+                jnp.take(bdata, hx, axis=1, mode="fill", fill_value=0),
+                0))                                  # [wb, sb]
+            freed = freed.at[hx].set(True, mode="drop")
+        bused = bused & ~freed
+        blen = jnp.where(freed, 0, blen)
+        n_shipped = jnp.sum(freed.astype(jnp.int32))
+        bw = jnp.concatenate([bw] + extra_rows, axis=0)
+
     rt = lax.all_to_all(bt, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
     rs = lax.all_to_all(bs, "actors", split_axis=0, concat_axis=0,
                         tiled=True)
     rw = lax.all_to_all(bw, "actors", split_axis=1, concat_axis=1,
                         tiled=True)
+
+    if blob is not None:
+        # --- migration, receive side: allocate a local slot per arrived
+        # payload (disjoint ranks over the compacted free list), write
+        # len+words, bump the slot generation, rewrite the handle word.
+        w1b = words.shape[0]
+        rw_main = rw[:w1b]
+        sb = shards * bucket
+        n_pos = len(positions)
+        permf, vfree, _ = compact_mask(~bused, bsl)
+        free_slots = jnp.where(vfree, permf.astype(jnp.int32), -1)
+        has_all = jnp.stack(
+            [(rw[w1b + k * (1 + wb)] >= 0).astype(jnp.int32)
+             for k in range(n_pos)])
+        rank = (jnp.cumsum(has_all.reshape(-1)) - 1).reshape(n_pos, sb)
+        n_dropped = jnp.int32(0)
+        new_words = [rw_main[i] for i in range(w1b)]
+        for k, wpos in enumerate(positions):
+            base_row = w1b + k * (1 + wb)
+            lenr = rw[base_row]
+            has = lenr >= 0
+            slot_l = jnp.take(free_slots, jnp.where(has, rank[k], bsl),
+                              mode="fill", fill_value=-1)
+            ok = has & (slot_l >= 0)
+            n_dropped = n_dropped + jnp.sum(
+                (has & ~ok).astype(jnp.int32))
+            sx = jnp.where(ok, slot_l, bsl)
+            newgen = (jnp.take(bgen, sx, mode="fill", fill_value=0)
+                      + 1) & pack.BLOB_GEN_MASK
+            bgen = bgen.at[sx].set(newgen, mode="drop")
+            bused = bused.at[sx].set(True, mode="drop")
+            blen = blen.at[sx].set(jnp.where(ok, lenr, 0), mode="drop")
+            bdata = bdata.at[:, sx].set(
+                jnp.where(ok[None, :], rw[base_row + 1:base_row + 1 + wb],
+                          jnp.take(bdata, sx, axis=1, mode="fill",
+                                   fill_value=0)), mode="drop")
+            newh = pack.blob_handle(bbase + slot_l, newgen)
+            # has & ok → fresh local handle; has & ~ok → dropped (null);
+            # ~has → original word untouched (not a blob for this gid,
+            # or a same-shard handle that skipped migration).
+            new_words[1 + wpos] = jnp.where(
+                ok, newh, jnp.where(has, jnp.int32(-1),
+                                    new_words[1 + wpos]))
+        rw = jnp.stack(new_words)
+        n_received = jnp.sum(has_all) - n_dropped
+        blob_out = ((bdata, bused, blen, bgen),
+                    n_shipped, n_received, n_dropped)
 
     nrej = jnp.sum(cnt - acc)
     w1 = words.shape[0]
@@ -809,7 +920,7 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
 
     received = Entries(tgt=rt, sender=rs, words=rw)
     return (received, new_rspill, jnp.minimum(nrej, rspill_cap),
-            nrej > rspill_cap, newly_muted, new_refs, new_ovf)
+            nrej > rspill_cap, newly_muted, new_refs, new_ovf, blob_out)
 
 
 def build_step(program: Program, opts: RuntimeOptions):
@@ -826,6 +937,13 @@ def build_step(program: Program, opts: RuntimeOptions):
     dev_cohorts = program.device_cohorts
     dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield, program), ch)
                    for ch in dev_cohorts]
+    # Blob migration over the mesh: active iff some behaviour ROUTES a
+    # Blob argument (static mask) and the pool is live (see _route).
+    route_blobs = False
+    if opts.blob_slots > 0 and p > 1:
+        from .gc import build_blob_arg_mask
+        _blob_route_mask = build_blob_arg_mask(program, opts.msg_words)
+        route_blobs = bool(_blob_route_mask.any())
     e_out, bucket, _n_entries = layout_sizes(program, opts)
     # Delivery priority levels (see delivery.deliver): 0 = receiver
     # spill, 1 = host inject, 2+k = sender cohort with k-th highest
@@ -1245,14 +1363,28 @@ def build_step(program: Program, opts: RuntimeOptions):
         route_muted = jnp.zeros((nl,), jnp.bool_)
         route_refs, route_ovf = empty_mute_slots(nl, opts.mute_slots)
         if p > 1:
+            rblob = None
+            if route_blobs:
+                rblob = {"data": blob_cur[0], "used": blob_cur[1],
+                         "len": blob_cur[2], "gen": blob_cur[3],
+                         "bbase": bbase, "bsl": bsl, "shard": shard,
+                         "mask": _blob_route_mask}
             (incoming, new_rspill, rsp_count, rsp_over, route_muted,
-             route_refs, route_ovf) = _route(
+             route_refs, route_ovf, route_blob_out) = _route(
                 out_cat, shards=p, n_local=nl, bucket=bucket,
                 rspill_cap=s_cap, overload_occ=opts.overload_occ,
                 head=new_head, tail=tail0, shard_base=base,
                 mute_slots=opts.mute_slots,
                 pressured_global=pressured_global,
-                pressured_local=st.pressured)
+                pressured_local=st.pressured, blob=rblob)
+            if route_blob_out is not None:
+                blob_cur, n_ship, n_recv, n_drop = route_blob_out
+                nb_free = nb_free + n_ship
+                nb_alloc = nb_alloc + n_recv
+                nb_moved = n_recv
+                nb_remote = nb_remote + n_drop
+            else:
+                nb_moved = jnp.int32(0)
             incoming = incoming._replace(
                 tgt=jnp.where(incoming.tgt >= 0, incoming.tgt - base, -1))
         else:
@@ -1262,6 +1394,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                                  st.rspill_words)   # unused, stays empty
             rsp_count = st.rspill_count[0]
             rsp_over = jnp.bool_(False)
+            nb_moved = jnp.int32(0)
 
         # --- 4. delivery list: receiver spill first (oldest), then host
         # injections, then routed messages. Injections are replicated to
@@ -1550,6 +1683,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_blob_alloc=vec(st.n_blob_alloc[0] + nb_alloc),
             n_blob_free=vec(st.n_blob_free[0] + nb_free),
             n_blob_remote=vec(st.n_blob_remote[0] + nb_remote),
+            n_blob_moved=vec(st.n_blob_moved[0] + nb_moved),
             type_state=new_type_state,
         )
         aux = StepAux(

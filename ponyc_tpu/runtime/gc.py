@@ -41,8 +41,10 @@ The same pass sweeps the device blob pool (≙ an actor's heap dying with
 the actor, mem/heap.c): a pool slot survives iff a surviving actor's
 Blob field holds its handle, a queued/spilled/injected message's Blob
 argument carries it, or the host owns it (blob_store not yet sent).
-Marking is shard-local by design — a handle moved off its owning shard
-is undereferenceable (v1 shard-local blobs) and is collected.
+Marking is shard-local by design — after migration (engine._route moves
+a blob WITH its routed message) every reachable handle is local to its
+pool's shard; the rare off-shard handle (host injection without
+near=, or a migration drop) is undereferenceable and is collected.
 """
 
 from __future__ import annotations
@@ -355,6 +357,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             blob_fail=st.blob_fail,
             n_blob_alloc=st.n_blob_alloc, n_blob_free=nbf2,
             n_blob_remote=st.n_blob_remote,
+            n_blob_moved=st.n_blob_moved,
             type_state=st.type_state,
         )
         if p > 1:

@@ -1235,9 +1235,10 @@ class Runtime:
         handle — typically then sent as a Blob argument. The HOST owns
         the blob until the send moves it.
 
-        `near`: an actor id whose SHARD should own the slot — on a mesh,
-        blobs are shard-local (v1), so allocate on the receiver's shard
-        or the handle arrives unreadable (null + n_blob_remote)."""
+        `near`: an actor id whose SHARD should own the slot. Host
+        INJECTIONS bypass the routing that migrates device-to-device
+        blobs, so allocate on the receiver's shard or the handle
+        arrives unreadable (null + n_blob_remote)."""
         if self.opts.blob_slots <= 0:
             raise RuntimeError("blob pool disabled: set "
                                "RuntimeOptions.blob_slots/blob_words")

@@ -200,9 +200,14 @@ class RtState:
     blob_fail: jnp.ndarray    # [P] bool — sticky: an alloc found no slot
     n_blob_alloc: jnp.ndarray   # [P] int32 — lifetime allocs
     n_blob_free: jnp.ndarray    # [P] int32 — lifetime frees
-    n_blob_remote: jnp.ndarray  # [P] int32 — Blob args that arrived on a
-    #   shard that doesn't own them (read as null; v1 blobs are
-    #   shard-local — the documented mesh semantics)
+    n_blob_remote: jnp.ndarray  # [P] int32 — Blob args that arrived
+    #   undereferenceable: host-injected off-shard handles (allocate
+    #   with blob_store(near=...)), or migration drops when the
+    #   receiving shard's pool was full (loud data loss, never
+    #   corruption)
+    n_blob_moved: jnp.ndarray   # [P] int32 — blobs that MIGRATED in
+    #   with a routed message (engine._route: payload rides the
+    #   all_to_all, fresh local slot + generation at the receiver)
 
     # Mesh-wide world facts from the previous tick's packed vote, stored
     # shard-uniform: bit0 = any pressured, bit1 = any muted, bit2 = any
@@ -295,6 +300,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         n_blob_alloc=jnp.zeros((p,), i32),
         n_blob_free=jnp.zeros((p,), i32),
         n_blob_remote=jnp.zeros((p,), i32),
+        n_blob_moved=jnp.zeros((p,), i32),
         type_state=type_state,
     )
 

@@ -14,8 +14,9 @@ capability checker. v1 scoped semantics under test here:
     free-then-use reject at build);
   - pool exhaustion raises BlobCapacityError host-side (sticky flag);
   - per-dispatch alloc budget = MAX_BLOBS (exceeding rejects at build);
-  - blobs are shard-local on a mesh: a handle delivered off-shard reads
-    as null and counts in n_blob_remote;
+  - on a mesh a blob MIGRATES with its routed message (fresh local
+    slot + generation at the receiver, engine._route; n_blob_moved);
+    host injections bypass routing — allocate near the receiver;
   - the host side allocates/reads via Runtime.blob_store/blob_fetch.
 """
 
@@ -387,38 +388,33 @@ def test_records_model_oracle():
     assert rt.counter("n_blob_free") == 48
 
 
-def test_mesh_remote_handle_reads_null_and_counts():
+def test_mesh_blob_migrates_with_routed_message():
     # 2-shard world: Producer on shard 0 allocates and sends to a
-    # Consumer row on shard 1 — v1 blobs are shard-local, so the handle
-    # arrives null: total stays 0 and n_blob_remote counts each Blob arg.
+    # Consumer row on shard 1 — the blob MIGRATES with the routed
+    # message (payload rides the all_to_all; fresh local slot +
+    # generation at the receiver), so the consumer reads it like any
+    # local blob and frees it normally.
     opts = RuntimeOptions(**{**OPTS, "mesh_shards": 2})
     rt = Runtime(opts)
     rt.declare(Producer, 4).declare(Consumer, 4).start()
     # slot_to_gid: even slots shard 0, odd slots shard 1.
     c1 = rt.spawn(Consumer, total=0, seen=0)    # slot 0 → shard 0
     c2 = rt.spawn(Consumer, total=0, seen=0)    # slot 1 → shard 1
-    p1 = rt.spawn(Producer, out=c2)             # slot 0 → shard 0: remote!
+    p1 = rt.spawn(Producer, out=c2)             # slot 0 → shard 0: routes!
     rt.send(p1, Producer.go, 3)
     rt.run(max_steps=10)
-    assert rt.state_of(c2)["total"] == 0        # null handle reads as 0
-    assert rt.state_of(c2)["seen"] == 0
-    assert rt.counter("n_blob_remote") == 1
-    # Same-shard delivery on the same mesh still works end-to-end:
-    # Producer slot 1 lands on shard 1, like c2.
-    p2 = rt.spawn(Producer, out=c2)
-    rt.send(p2, Producer.go, 3)
-    rt.run(max_steps=10)
     assert rt.state_of(c2)["total"] == 30 + 31 + 32 + 33
-    assert rt.state_of(c2)["seen"] == 4
-    assert rt.counter("n_blob_remote") == 1     # unchanged
-    assert rt.blobs_in_use == 1                 # the orphaned remote blob:
-    # the handle was moved off-shard and nulled — nobody can free it
-    # explicitly...
-    rt.gc()
-    assert rt.blobs_in_use == 0                 # ...but the GC mark pass
-    # sweeps it (shard-local marking: an off-shard handle marks nothing)
-
-
+    assert rt.state_of(c2)["seen"] == 4         # full logical length
+    assert rt.counter("n_blob_moved") == 1      # one cross-shard hop
+    assert rt.counter("n_blob_remote") == 0     # nothing arrived dead
+    assert rt.blobs_in_use == 0                 # freed at the receiver
+    # Same-shard delivery migrates nothing (off-shard blocks only).
+    p2 = rt.spawn(Producer, out=c2)             # slot 1 → shard 1: local
+    rt.send(p2, Producer.go, 5)
+    rt.run(max_steps=10)
+    assert rt.state_of(c2)["total"] == 126 + 50 + 51 + 52 + 53
+    assert rt.counter("n_blob_moved") == 1      # unchanged
+    assert rt.blobs_in_use == 0
 def test_gc_sweeps_dead_actor_field_blobs():
     # An actor holding a blob in a Blob FIELD dies unreachable → the
     # next collection frees both the actor and its blob (≙ the actor's

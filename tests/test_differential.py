@@ -351,19 +351,16 @@ class BlobWalker:
         return {**st, "acc": st["acc"] + w0}
 
 
-@pytest.mark.parametrize("mode,shards", [("plan", 1), ("cosort", 1),
-                                         ("plan", 2)])
-def test_blob_chain_matches_oracle(mode, shards):
+@pytest.mark.parametrize("mode,shards,bucket", [
+    ("plan", 1, 0), ("cosort", 1, 0), ("plan", 2, 0),
+    # Tiny route bucket: blob-carrying messages PARK in the route spill
+    # and migrate only when the retry actually ships — the
+    # spilled-blobs-stay-local invariant under congestion.
+    ("plan", 2, 2)])
+def test_blob_chain_matches_oracle(mode, shards, bucket):
     rng = np.random.default_rng(77)
     n = 16
     nxt = rng.integers(0, n, n)
-
-    if shards > 1:
-        # v1 blobs are shard-local: keep each chain on ONE shard by
-        # wiring successors within the same parity class (slot % shards
-        # picks the shard — slot_to_gid), and allocating near the seed.
-        nxt = np.asarray([i if (nxt[i] - i) % shards else int(nxt[i])
-                          for i in range(n)])
 
     def oracle_blob(seeds):
         from collections import deque
@@ -383,12 +380,16 @@ def test_blob_chain_matches_oracle(mode, shards):
     opts = RuntimeOptions(mailbox_cap=2, batch=1, msg_words=3,
                           max_sends=1, spill_cap=1024, inject_slots=16,
                           delivery=mode, mesh_shards=shards,
+                          route_bucket=bucket,
                           blob_slots=256, blob_words=2)
     rt = Runtime(opts)
     rt.declare(BlobWalker, n).start()
     ids = rt.spawn_many(BlobWalker, n, acc=0)
     rt.set_fields(BlobWalker, ids, nxt=ids[np.asarray(nxt)])
     for i, v, w in seeds:
+        # Host injections don't route, so allocate on the seed's shard;
+        # after that, chains cross shards freely — blobs MIGRATE with
+        # the routed messages (engine._route).
         h = rt.blob_store([w], near=int(ids[i]))
         rt.send(int(ids[i]), BlobWalker.step, v, h)
     assert rt.run(max_steps=100_000) == 0
@@ -396,4 +397,6 @@ def test_blob_chain_matches_oracle(mode, shards):
     assert (st["acc"][:n].astype(np.int64) == want).all(), (
         st["acc"][:n], want)
     assert rt.blobs_in_use == 0            # every chain end freed its blob
-    assert rt.counter("n_blob_remote") == 0
+    assert rt.counter("n_blob_remote") == 0    # nothing arrived dead
+    if shards > 1:
+        assert rt.counter("n_blob_moved") > 0  # chains DID cross shards
