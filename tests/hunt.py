@@ -2,10 +2,13 @@
 """Extended differential bug hunt — the long-running version of
 tests/test_differential.py, run as a one-off (not under pytest):
 
-    python tests/hunt.py [n_seeds] [first_seed] [--fifo]
+    python tests/hunt.py [n_seeds] [first_seed] [--fifo|--blob]
 
 --fifo runs the order-sensitive per-edge FIFO marathon (test_fifo.py
-scenarios) instead of the commutative-outcome differential.
+scenarios) instead of the commutative-outcome differential; --blob
+runs randomized blob-chain worlds (device payload pool: alloc/free
+churn per hop, iso moves, cross-shard migration) against the
+sequential oracle.
 
 Random world sizes and traffic per seed, rotating configurations
 (tiny-cap single chip, cosort, fused kernel, 4/8-shard meshes with tiny
@@ -50,6 +53,55 @@ CONFIGS = {
 }
 
 
+BLOB_CONFIGS = {
+    "tiny": dict(mailbox_cap=2, batch=1, max_sends=1, spill_cap=1024,
+                 inject_slots=16),
+    "cosort": dict(mailbox_cap=4, batch=2, max_sends=1, spill_cap=1024,
+                   inject_slots=16, delivery="cosort"),
+    "mesh2": dict(mailbox_cap=2, batch=1, max_sends=1, spill_cap=2048,
+                  inject_slots=16, mesh_shards=2, quiesce_interval=2),
+    "mesh4-bucket": dict(mailbox_cap=2, batch=1, max_sends=1,
+                         spill_cap=4096, inject_slots=32, mesh_shards=4,
+                         route_bucket=4, quiesce_interval=1),
+    "aged": dict(mailbox_cap=2, batch=1, max_sends=1, spill_cap=1024,
+                 inject_slots=16, mute_age_limit=2),
+}
+
+
+def _marathon(n_seeds, first, configs, run_seed, label):
+    """Shared per-seed driver for the call-one-function marathons
+    (fifo/blob): rotate configs, record failures, summarise."""
+    fails = []
+    t0 = time.time()
+    names = list(configs)
+    for n, seed in enumerate(range(first, first + n_seeds)):
+        cfg = names[n % len(names)]
+        try:
+            detail = run_seed(seed, cfg, configs[cfg])
+        except Exception as e:                  # noqa: BLE001
+            fails.append((seed, cfg, repr(e)[:200]))
+            detail = ""
+        print(f"{label} seed {seed} ({cfg}{detail}): "
+              f"{'FAIL' if fails and fails[-1][0] == seed else 'ok'}",
+              flush=True)
+    print(f"\n{n_seeds - len(fails)}/{n_seeds} {label} ok "
+          f"in {time.time() - t0:.0f}s")
+    for f in fails:
+        print("FAIL:", f)
+    return 1 if fails else 0
+
+
+def main_blob(n_seeds, first):
+    """Blob-chain marathon: randomized worlds through td.run_blob_chain
+    (alloc/free churn every hop, generation recycling, migration under
+    tiny route buckets); any oracle mismatch, leak, or dead arrival
+    fails the seed."""
+    def run_seed(seed, _cfg, kw):
+        td.run_blob_chain(seed, kw)
+        return ""
+    return _marathon(n_seeds, first, BLOB_CONFIGS, run_seed, "blob")
+
+
 FIFO_CONFIGS = {
     "tiny": dict(mailbox_cap=2, batch=1, max_sends=3, spill_cap=4096,
                  inject_slots=16),
@@ -73,36 +125,26 @@ def main_fifo(n_seeds, first):
     a single FIFO inversion anywhere in delivery/spill/route/aged-unmute
     fails the seed."""
     import test_fifo as tf
-    fails = []
-    t0 = time.time()
-    names = list(FIFO_CONFIGS)
-    for n, seed in enumerate(range(first, first + n_seeds)):
+
+    def run_seed(seed, _cfg, kw):
         rng = np.random.default_rng(seed)
         n_cons = int(rng.integers(3, 12))
         items = int(rng.integers(20, 90))
-        cfg = names[n % len(names)]
-        try:
-            tf.run_fifo(seed, FIFO_CONFIGS[cfg], n_cons=n_cons,
-                        items=items)
-        except Exception as e:                  # noqa: BLE001
-            fails.append((seed, cfg, repr(e)[:200]))
-        print(f"fifo seed {seed} ({cfg}, n_cons={n_cons}, items={items}): "
-              f"{'FAIL' if fails and fails[-1][0] == seed else 'ok'}",
-              flush=True)
-    print(f"\n{n_seeds - len(fails)}/{n_seeds} fifo ok "
-          f"in {time.time() - t0:.0f}s")
-    for f in fails:
-        print("FAIL:", f)
-    return 1 if fails else 0
+        tf.run_fifo(seed, kw, n_cons=n_cons, items=items)
+        return f", n_cons={n_cons}, items={items}"
+    return _marathon(n_seeds, first, FIFO_CONFIGS, run_seed, "fifo")
 
 
 def main():
-    argv = [a for a in sys.argv[1:] if a != "--fifo"]
+    argv = [a for a in sys.argv[1:] if a not in ("--fifo", "--blob")]
     fifo = "--fifo" in sys.argv[1:]
+    blob = "--blob" in sys.argv[1:]
     n_seeds = int(argv[0]) if len(argv) > 0 else 10
     first = int(argv[1]) if len(argv) > 1 else 1000
     if fifo:
         return main_fifo(n_seeds, first)
+    if blob:
+        return main_blob(n_seeds, first)
     fails = []
     t0 = time.time()
     names = list(CONFIGS)

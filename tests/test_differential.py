@@ -351,15 +351,14 @@ class BlobWalker:
         return {**st, "acc": st["acc"] + w0}
 
 
-@pytest.mark.parametrize("mode,shards,bucket", [
-    ("plan", 1, 0), ("cosort", 1, 0), ("plan", 2, 0),
-    # Tiny route bucket: blob-carrying messages PARK in the route spill
-    # and migrate only when the retry actually ships — the
-    # spilled-blobs-stay-local invariant under congestion.
-    ("plan", 2, 2)])
-def test_blob_chain_matches_oracle(mode, shards, bucket):
-    rng = np.random.default_rng(77)
-    n = 16
+def run_blob_chain(seed, opts_kw, n=None, n_starts=6, vmax=10,
+                   expect_moves=False):
+    """One randomized blob-chain world vs the sequential oracle
+    (shared by the pytest cases below and tests/hunt.py --blob): random
+    functional graph, random seeds; every hop reads + frees + re-allocs
+    the token blob, chains cross shards freely (migration)."""
+    rng = np.random.default_rng(seed)
+    n = n or int(rng.integers(8, 40))
     nxt = rng.integers(0, n, n)
 
     def oracle_blob(seeds):
@@ -373,15 +372,11 @@ def test_blob_chain_matches_oracle(mode, shards, bucket):
                 q.append((int(nxt[i]), v - 1, w + 1))
         return acc
 
-    seeds = [(int(rng.integers(0, n)), int(rng.integers(1, 10)),
-              int(rng.integers(0, 50))) for _ in range(6)]
+    seeds = [(int(rng.integers(0, n)), int(rng.integers(1, vmax)),
+              int(rng.integers(0, 50))) for _ in range(n_starts)]
     want = oracle_blob(seeds)
-
-    opts = RuntimeOptions(mailbox_cap=2, batch=1, msg_words=3,
-                          max_sends=1, spill_cap=1024, inject_slots=16,
-                          delivery=mode, mesh_shards=shards,
-                          route_bucket=bucket,
-                          blob_slots=256, blob_words=2)
+    opts = RuntimeOptions(msg_words=3, blob_slots=256, blob_words=2,
+                          **opts_kw)
     rt = Runtime(opts)
     rt.declare(BlobWalker, n).start()
     ids = rt.spawn_many(BlobWalker, n, acc=0)
@@ -398,5 +393,20 @@ def test_blob_chain_matches_oracle(mode, shards, bucket):
         st["acc"][:n], want)
     assert rt.blobs_in_use == 0            # every chain end freed its blob
     assert rt.counter("n_blob_remote") == 0    # nothing arrived dead
-    if shards > 1:
+    if expect_moves:
         assert rt.counter("n_blob_moved") > 0  # chains DID cross shards
+    return rt
+
+
+@pytest.mark.parametrize("mode,shards,bucket", [
+    ("plan", 1, 0), ("cosort", 1, 0), ("plan", 2, 0),
+    # Tiny route bucket: blob-carrying messages PARK in the route spill
+    # and migrate only when the retry actually ships — the
+    # spilled-blobs-stay-local invariant under congestion.
+    ("plan", 2, 2)])
+def test_blob_chain_matches_oracle(mode, shards, bucket):
+    run_blob_chain(77, dict(mailbox_cap=2, batch=1, max_sends=1,
+                            spill_cap=1024, inject_slots=16,
+                            delivery=mode, mesh_shards=shards,
+                            route_bucket=bucket),
+                   n=16, expect_moves=shards > 1)
