@@ -11,7 +11,7 @@ tick (ICI collectives across chips), and I/O + bookkeeping stay host-side.
 See SURVEY.md at the repo root for the full mapping to the reference.
 """
 
-from .api import (Actor, Blob, Bool, Box, Context, F32, I8, I16, I32,
+from .api import (Actor, Blob, BlobVal, Bool, Box, Context, F32, I8, I16, I32,
                   Iso, Mut, Ref, Tag, Trn, TypeParam, U8, U16, U32, Val,
                   VecF32, VecI32, actor, be, behaviour)
 from .config import RuntimeOptions, options_from_env, strip_runtime_flags
@@ -22,7 +22,7 @@ from .runtime.runtime import (BlobCapacityError, Runtime,
 __version__ = "0.1.0"
 
 __all__ = [
-    "Actor", "Blob", "Bool", "Box", "Context", "F32", "I8", "I16", "I32", "Iso",
+    "Actor", "Blob", "BlobVal", "Bool", "Box", "Context", "F32", "I8", "I16", "I32", "Iso",
     "Mut", "Ref", "Tag", "Trn", "TypeParam", "U8", "U16", "U32", "Val",
     "VecF32", "VecI32", "actor", "be",
     "behaviour", "RuntimeOptions", "options_from_env",

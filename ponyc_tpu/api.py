@@ -41,9 +41,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from .ops import pack
-from .ops.pack import (Blob, Bool, Box, F32, I8, I16, I32, Iso, Mut,  # noqa
-                       Ref, Tag, Trn, TypeParam, U8, U16, U32, Val,
-                       VecF32, VecI32)  # re-exported
+from .ops.pack import (Blob, BlobVal, Bool, Box, F32, I8, I16, I32,  # noqa
+                       Iso, Mut, Ref, Tag, Trn, TypeParam, U8, U16,
+                       U32, Val, VecF32, VecI32)  # re-exported
 
 
 class BehaviourDef:
@@ -728,6 +728,22 @@ class Context:
         hl, _ok = b.local(h)
         return jnp.take(b.len_, hl, mode="fill", fill_value=0)
 
+    def blob_freeze(self, h):
+        """Freeze an owned (iso) blob into shared-immutable VAL (≙
+        Pony's consume-to-val — `recover val` / trn→val freeze): the
+        returned handle aliases freely, so one dispatch may send it to
+        MANY readers (declare the parameter ``BlobVal``); writes and
+        frees reject at trace; the slot is reclaimed by the GC mark
+        pass once no live field/message/host root references it.
+        Idempotent on already-val handles."""
+        self._require_blob("blob_freeze")
+        self._blob_guard(h, "blob_freeze")
+        src = self.cap_types.lookup(h)
+        if src == "val":
+            return h
+        self.cap_types.tag(h, "val")
+        return h
+
     def blob_set(self, h, i, v, when=True):
         """Write word `i` of blob `h` (i32; masked by `when`). Only the
         owner holds the handle (iso), so lanes never collide; writes are
@@ -736,6 +752,11 @@ class Context:
         ``value.view(jnp.int32)``."""
         b = self._require_blob("blob_set")
         self._blob_guard(h, "blob_set")
+        if self.cap_types.lookup(h) == "val":
+            raise TypeError(
+                "capability: blob_set on a frozen (val) blob — "
+                "shared-immutable payloads cannot be written "
+                "(≙ val's deny-write, type/cap.c)")
         h = jnp.asarray(h, jnp.int32)
         hl, okh = b.local(h)
         i = jnp.asarray(i, jnp.int32)
@@ -757,6 +778,11 @@ class Context:
         later use of the handle in this dispatch is rejected at trace."""
         b = self._require_blob("blob_free")
         self._blob_guard(h, "blob_free")
+        if self.cap_types.lookup(h) == "val":
+            raise TypeError(
+                "capability: blob_free on a frozen (val) blob — shared "
+                "payloads have no single owner to free them; the GC "
+                "mark pass reclaims unreferenced val blobs")
         h = jnp.asarray(h, jnp.int32)
         hl, okh = b.local(h)
         ok = (jnp.asarray(when, jnp.bool_) & b.take & okh

@@ -250,31 +250,48 @@ Tag = _CapSpec("tag")
 
 
 class _BlobSpec(_CapSpec):
-    """Device blob handle annotation (``Blob``).
+    """Device blob handle annotation (``Blob`` / ``BlobVal``).
 
     ≙ the reference's rich message payloads that live on an ACTOR HEAP
     and ride messages by pointer (pony_alloc_msg + gc trace,
     pony.h:332-360; genfun.c packs a pony_msg_t per behaviour) — here
     the "heap" is the device-resident blob pool
     (RuntimeOptions.blob_slots × blob_words, runtime/state.py) and the
-    "pointer" is a global blob handle (i32; -1 = null). The mode is
-    fixed ``iso``: a blob has exactly ONE owner, sending the handle is a
-    MOVE (the full trace-time move/alias discipline of Iso applies),
-    and the owner reads/writes/frees it via ctx.blob_* (api.Context).
-    Unlike Iso (a HostHeap handle — host round-trip to touch), Blob
-    words are readable and writable INSIDE device behaviours."""
+    "pointer" is a global blob handle (i32; -1 = null).
+
+    ``Blob`` (mode iso): exactly ONE owner; sending the handle is a
+    MOVE (the full trace-time move/alias discipline of Iso applies);
+    the owner reads/writes/frees it via ctx.blob_* (api.Context).
+
+    ``BlobVal`` (mode val, ≙ Pony's ubiquitous `String val`/`Array
+    val` payloads): shared-immutable after ctx.blob_freeze(h) — the
+    handle aliases freely (one dispatch may send it to MANY readers),
+    writes and frees reject at trace, and the slot is reclaimed by the
+    GC mark pass when no live field/message references it. Across a
+    mesh, a val blob COPIES with each routed message (readers on other
+    shards get their own immutable replica; each shard's sweep
+    collects its copy) where an iso blob MOVES.
+
+    Unlike Iso/Val HostHeap handles (host round-trip to touch), blob
+    words are readable INSIDE device behaviours."""
 
     @property
     def __name__(self) -> str:          # noqa: A003
-        return "Blob"
+        return "Blob" if self.mode == "iso" else "BlobVal"
 
 
 Blob = _BlobSpec("iso")
+BlobVal = _BlobSpec("val")
 
 
 def is_blob(ann) -> bool:
-    """Is this annotation a device blob handle?"""
+    """Is this annotation a device blob handle (either mode)?"""
     return isinstance(ann, _BlobSpec)
+
+
+def is_blob_val(ann) -> bool:
+    """Is this a shared-immutable (val) blob annotation?"""
+    return isinstance(ann, _BlobSpec) and ann.mode == "val"
 
 
 def null_word(ann) -> int:
@@ -524,9 +541,10 @@ def normalize_annotation(ann):
     if isinstance(ann, (_RefTo, _VecSpec, _CapSpec, TypeParam)):
         return ann
     if isinstance(ann, str) and ann in ("Iso", "Trn", "Mut", "Val",
-                                        "Box", "Tag", "Blob"):
+                                        "Box", "Tag", "Blob", "BlobVal"):
         return {"Iso": Iso, "Trn": Trn, "Mut": Mut, "Val": Val,
-                "Box": Box, "Tag": Tag, "Blob": Blob}[ann]
+                "Box": Box, "Tag": Tag, "Blob": Blob,
+                "BlobVal": BlobVal}[ann]
     if ann in _MARKERS:
         return ann
     if isinstance(ann, str) and ann.endswith("]"):

@@ -775,8 +775,9 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
         bdata, bused, blen, bgen = (blob["data"], blob["used"],
                                     blob["len"], blob["gen"])
         bbase, bsl = blob["bbase"], blob["bsl"]
-        mask_np = blob["mask"]                   # STATIC numpy mask
+        mask_np = blob["mask"]                   # STATIC numpy masks
         mask = jnp.asarray(mask_np)
+        mask_iso = jnp.asarray(blob["mask_iso"])
         wb = bdata.shape[0]
         n_gids = mask.shape[0]
         sb = shards * bucket
@@ -808,7 +809,10 @@ def _route(entries: Entries, *, shards: int, n_local: int, bucket: int,
                 okh[None, :],
                 jnp.take(bdata, hx, axis=1, mode="fill", fill_value=0),
                 0))                                  # [wb, sb]
-            freed = freed.at[hx].set(True, mode="drop")
+            # Iso handles MOVE (source freed); val handles COPY — the
+            # receiver gets a replica, other readers keep the original.
+            freed = freed.at[jnp.where(okh & mask_iso[g, wpos],
+                                       hl, bsl)].set(True, mode="drop")
         bused = bused & ~freed
         blen = jnp.where(freed, 0, blen)
         n_shipped = jnp.sum(freed.astype(jnp.int32))
@@ -943,6 +947,10 @@ def build_step(program: Program, opts: RuntimeOptions):
     if opts.blob_slots > 0 and p > 1:
         from .gc import build_blob_arg_mask
         _blob_route_mask = build_blob_arg_mask(program, opts.msg_words)
+        # Iso-mode positions MOVE (source slot freed); val-mode (frozen,
+        # shared) positions COPY — other readers keep the source.
+        _blob_route_mask_iso = build_blob_arg_mask(
+            program, opts.msg_words, mode="iso")
         route_blobs = bool(_blob_route_mask.any())
     e_out, bucket, _n_entries = layout_sizes(program, opts)
     # Delivery priority levels (see delivery.deliver): 0 = receiver
@@ -1368,7 +1376,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                 rblob = {"data": blob_cur[0], "used": blob_cur[1],
                          "len": blob_cur[2], "gen": blob_cur[3],
                          "bbase": bbase, "bsl": bsl, "shard": shard,
-                         "mask": _blob_route_mask}
+                         "mask": _blob_route_mask,
+                         "mask_iso": _blob_route_mask_iso}
             (incoming, new_rspill, rsp_count, rsp_over, route_muted,
              route_refs, route_ovf, route_blob_out) = _route(
                 out_cat, shards=p, n_local=nl, bucket=bucket,

@@ -83,17 +83,21 @@ def _ref_fields(cohort):
             if is_ref(spec)]
 
 
-def build_blob_arg_mask(program: Program, msg_words: int) -> np.ndarray:
+def build_blob_arg_mask(program: Program, msg_words: int,
+                        mode: str | None = None) -> np.ndarray:
     """Static [n_gids, msg_words] bool: which payload words of each
     behaviour message are device blob handles (the Blob twin of
-    build_ref_arg_mask — ≙ gentrace.c tracing message object fields)."""
+    build_ref_arg_mask — ≙ gentrace.c tracing message object fields).
+    `mode` narrows to one capability ("iso": owned/moving handles,
+    "val": shared-immutable); None = both."""
     from ..ops.pack import is_blob, spec_width
     n = len(program.behaviour_table)
     mask = np.zeros((max(n, 1), msg_words), bool)
     for gid, bdef in enumerate(program.behaviour_table):
         off = 0
         for spec in bdef.arg_specs:
-            if is_blob(spec) and off < msg_words:
+            if (is_blob(spec) and off < msg_words
+                    and (mode is None or spec.mode == mode)):
                 mask[gid, off] = True
             off += spec_width(spec)
     return mask

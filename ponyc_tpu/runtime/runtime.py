@@ -579,11 +579,13 @@ class Runtime:
                         and not pack.is_blob(spec) and int(a) > 0):
                     heap.send_iso(int(a))
         if self._host_blobs:
-            # A sent blob handle is MOVED off the host: it stops being a
-            # GC root here (the in-flight message keeps it alive until
-            # the receiver owns it — gc.py's mailbox/inject marks).
+            # A sent ISO blob handle is MOVED off the host: it stops
+            # being a GC root here (the in-flight message keeps it
+            # alive until the receiver owns it — gc.py's marks). A VAL
+            # (shared) handle ALIASES: the host keeps its root until
+            # rt.blob_release(h), so it can keep sending/fetching it.
             for spec, a in zip(behaviour_def.arg_specs, args):
-                if pack.is_blob(spec):
+                if pack.is_blob(spec) and not pack.is_blob_val(spec):
                     self._host_blobs.discard(int(a))
         # Host senders (the API and host behaviours both run here) to
         # host targets take the fast lane; everything else rides the
@@ -613,12 +615,13 @@ class Runtime:
         self._check_ref_args(behaviour_def.arg_specs, arg_cols,
                              f"{behaviour_def.actor_type.__name__}."
                              f"{behaviour_def.name}")
-        # Blob columns MOVE off the host exactly like send() args (the
-        # handles stop being GC roots; in-flight mailbox words keep the
-        # blobs alive until the receivers own them).
+        # ISO blob columns MOVE off the host exactly like send() args
+        # (the handles stop being GC roots; in-flight mailbox words keep
+        # the blobs alive until the receivers own them); VAL columns
+        # alias — the host keeps its roots until rt.blob_release.
         if self._host_blobs:
             for spec, col in zip(behaviour_def.arg_specs, arg_cols):
-                if pack.is_blob(spec):
+                if pack.is_blob(spec) and not pack.is_blob_val(spec):
                     for a in np.asarray(col).reshape(-1):
                         self._host_blobs.discard(int(a))
         k = len(targets)
@@ -1293,6 +1296,13 @@ class Runtime:
             blob_used=st.blob_used.at[slot].set(False),
             blob_len=st.blob_len.at[slot].set(0),
             n_blob_free=st.n_blob_free.at[shard].add(1))
+        self._host_blobs.discard(int(handle))
+
+    def blob_release(self, handle: int) -> None:
+        """Drop the host's GC ROOT on a handle without freeing the
+        slot — the val-blob release path (device readers may still hold
+        it; the next gc() reclaims it once nobody does). For a handle
+        the host exclusively owns, blob_free_host frees immediately."""
         self._host_blobs.discard(int(handle))
 
     @property

@@ -487,3 +487,149 @@ def test_gc_keeps_host_held_and_inflight_blobs():
     assert rt.blobs_in_use == 1
     rt.blob_free_host(h_held)
     assert rt.blobs_in_use == 0
+
+
+def test_freeze_shares_one_payload_with_many_readers():
+    # ≙ Pony's `String val` broadcast: freeze once, send the SAME
+    # handle to two readers in one dispatch (an iso handle would reject
+    # the second send as an aliased move); nobody frees — the GC mark
+    # pass reclaims the slot once the readers have consumed it.
+    from ponyc_tpu import BlobVal
+
+    @actor
+    class Caster(Actor):
+        a: Ref["ValReader"]
+        b: Ref["ValReader"]
+        MAX_BLOBS = 1
+        MAX_SENDS = 2
+
+        @behaviour
+        def cast(self, st, x: I32):
+            h = self.blob_alloc(length=2)
+            self.blob_set(h, 0, x)
+            self.blob_set(h, 1, x * 2)
+            v = self.blob_freeze(h)
+            self.send(st["a"], ValReader.read, v)
+            self.send(st["b"], ValReader.read, v)   # alias: legal for val
+            return st
+
+    @actor
+    class ValReader(Actor):
+        got: I32
+
+        @behaviour
+        def read(self, st, v: BlobVal):
+            return {**st, "got": st["got"] + self.blob_get(v, 0)
+                    + self.blob_get(v, 1)}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Caster, 2).declare(ValReader, 4).start()
+    r1 = rt.spawn(ValReader, got=0)
+    r2 = rt.spawn(ValReader, got=0)
+    c = rt.spawn(Caster, a=r1, b=r2)
+    rt.send(c, Caster.cast, 7)
+    rt.run(max_steps=10)
+    assert rt.state_of(r1)["got"] == 7 + 14
+    assert rt.state_of(r2)["got"] == 7 + 14
+    assert rt.blobs_in_use == 1          # nobody freed (val has no owner)
+    rt.gc()                              # ...but nothing references it now
+    assert rt.blobs_in_use == 0
+
+
+def test_frozen_blob_rejects_write_and_free():
+    @actor
+    class BadFreezer(Actor):
+        n: I32
+        MAX_BLOBS = 1
+
+        @behaviour
+        def w(self, st):
+            h = self.blob_freeze(self.blob_alloc(length=1))
+            self.blob_set(h, 0, 1)               # write-after-freeze
+            return st
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(BadFreezer, 2).start()
+    with pytest.raises(TypeError, match="frozen"):
+        rt.run(max_steps=1)
+
+    @actor
+    class BadFreer(Actor):
+        n: I32
+        MAX_BLOBS = 1
+
+        @behaviour
+        def f(self, st):
+            h = self.blob_freeze(self.blob_alloc(length=1))
+            self.blob_free(h)                    # free-after-freeze
+            return st
+
+    rt2 = Runtime(RuntimeOptions(**OPTS))
+    rt2.declare(BadFreer, 2).start()
+    with pytest.raises(TypeError, match="val"):
+        rt2.run(max_steps=1)
+
+
+def test_frozen_handle_rejects_iso_parameter():
+    @actor
+    class Smuggler(Actor):
+        out: Ref["Consumer"]
+        MAX_BLOBS = 1
+        MAX_SENDS = 1
+
+        @behaviour
+        def go(self, st):
+            v = self.blob_freeze(self.blob_alloc(length=1))
+            self.send(st["out"], Consumer.take, v)   # Consumer.take: Blob
+            return st
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Smuggler, 2).declare(Consumer, 2).start()
+    with pytest.raises(TypeError, match="val"):
+        rt.run(max_steps=1)
+
+
+def test_mesh_val_blob_copies_not_moves():
+    # A frozen blob broadcast to readers on BOTH shards: the off-shard
+    # reader gets a COPY (migration does not free the source), the
+    # local reader reads the original; gc reclaims both replicas.
+    from ponyc_tpu import BlobVal
+
+    @actor
+    class Caster(Actor):
+        a: Ref["VReader"]
+        b: Ref["VReader"]
+        MAX_BLOBS = 1
+        MAX_SENDS = 2
+
+        @behaviour
+        def cast(self, st, x: I32):
+            h = self.blob_alloc(length=1)
+            self.blob_set(h, 0, x)
+            v = self.blob_freeze(h)
+            self.send(st["a"], VReader.read, v)
+            self.send(st["b"], VReader.read, v)
+            return st
+
+    @actor
+    class VReader(Actor):
+        got: I32
+
+        @behaviour
+        def read(self, st, v: BlobVal):
+            return {**st, "got": st["got"] + self.blob_get(v, 0)}
+
+    opts = RuntimeOptions(**{**OPTS, "mesh_shards": 2})
+    rt = Runtime(opts)
+    rt.declare(Caster, 2).declare(VReader, 4).start()
+    r_local = rt.spawn(VReader, got=0)   # slot 0 → shard 0
+    r_remote = rt.spawn(VReader, got=0)  # slot 1 → shard 1
+    c = rt.spawn(Caster, a=r_local, b=r_remote)   # slot 0 → shard 0
+    rt.send(c, Caster.cast, 41)
+    rt.run(max_steps=10)
+    assert rt.state_of(r_local)["got"] == 41      # original
+    assert rt.state_of(r_remote)["got"] == 41     # replica
+    assert rt.counter("n_blob_moved") == 1        # the copy that crossed
+    assert rt.blobs_in_use == 2                   # original + replica
+    rt.gc()
+    assert rt.blobs_in_use == 0                   # both reclaimed
