@@ -1298,6 +1298,34 @@ class Runtime:
             n_blob_free=st.n_blob_free.at[shard].add(1))
         self._host_blobs.discard(int(handle))
 
+    def blob_store_str(self, text: str, near: Optional[int] = None
+                       ) -> int:
+        """Store a UTF-8 string as a device blob (4 bytes/word): the
+        `String val`-style payload path; pair with blob_fetch_str.
+        blob_len records WORDS (the pool's logical unit); the byte
+        count is recovered by stripping the zero-padding of the final
+        word, so U+0000 in the text is rejected here rather than
+        silently truncated on the way back."""
+        raw = text.encode("utf-8")
+        if b"\x00" in raw:
+            raise ValueError(
+                "blob_store_str: NUL (U+0000) in text is "
+                "indistinguishable from word padding; store raw words "
+                "with blob_store instead")
+        if len(raw) > 4 * self.opts.blob_words:
+            raise ValueError(
+                f"{len(raw)} bytes > 4*blob_words="
+                f"{4 * self.opts.blob_words}")
+        padded = raw + b"\x00" * (-len(raw) % 4)
+        words = np.frombuffer(padded, np.int32) if padded else \
+            np.zeros((0,), np.int32)
+        return self.blob_store(words, near=near)
+
+    def blob_fetch_str(self, handle: int) -> str:
+        """Read back a blob_store_str payload."""
+        words = np.ascontiguousarray(self.blob_fetch(handle), np.int32)
+        return words.tobytes().rstrip(b"\x00").decode("utf-8")
+
     def blob_release(self, handle: int) -> None:
         """Drop the host's GC ROOT on a handle without freeing the
         slot — the val-blob release path (device readers may still hold

@@ -41,6 +41,7 @@ class Effects:
     can_yield: bool               # ctx.yield_ reachable
     spawns: Tuple[Tuple[str, int], ...]   # (target type, claim sites)
     sync_spawns: Tuple[str, ...]  # targets constructed synchronously
+    blob_allocs: int = 0          # ctx.blob_alloc call sites (≤ MAX_BLOBS)
 
     def marks(self) -> str:
         """Compact docgen suffix (≙ Pony's `?` partial mark)."""
@@ -52,6 +53,8 @@ class Effects:
         if self.sync_spawns:
             out.append("sync-constructs "
                        + ",".join(sorted(set(self.sync_spawns))))
+        if self.blob_allocs:
+            out.append(f"allocs blobs×{self.blob_allocs}")
         if self.can_error:
             out.append("may error")      # ≙ the `?` mark
         if self.can_destroy:
@@ -167,6 +170,7 @@ def behaviour_effects(bdef: BehaviourDef,
         spawns=tuple(sorted((t, len(c))
                             for t, c in ctx.spawn_claims.items() if c)),
         sync_spawns=tuple(sorted(ctx.sync_inits.keys())),
+        blob_allocs=(ctx._blob.claims if ctx._blob is not None else 0),
     )
 
 

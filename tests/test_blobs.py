@@ -633,3 +633,55 @@ def test_mesh_val_blob_copies_not_moves():
     assert rt.blobs_in_use == 2                   # original + replica
     rt.gc()
     assert rt.blobs_in_use == 0                   # both reclaimed
+
+
+def test_string_payload_roundtrip():
+    # The `String val` payload path: host stores UTF-8 text as a blob,
+    # a device actor forwards the handle, the host reads it back.
+    @actor
+    class Fwd(Actor):
+        sink: Ref["Keeper"]
+        MAX_SENDS = 1
+
+        @behaviour
+        def fwd(self, st, h: Blob):
+            self.send(st["sink"], Keeper.keep, h)
+            return st
+
+    @actor
+    class Keeper(Actor):
+        held: Blob
+
+        @behaviour
+        def keep(self, st, h: Blob):
+            return {**st, "held": h}
+
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Fwd, 2).declare(Keeper, 2).start()
+    k = rt.spawn(Keeper, held=-1)
+    f = rt.spawn(Fwd, sink=k)
+    h = rt.blob_store_str("héllo, pony→tpu")
+    rt.send(f, Fwd.fwd, h)
+    rt.run(max_steps=8)
+    h2 = int(rt.state_of(k)["held"])
+    assert h2 == h                        # same-chip: handle unchanged
+    assert rt.blob_fetch_str(h2) == "héllo, pony→tpu"
+
+
+def test_verify_marks_blob_allocs():
+    from ponyc_tpu.verify import behaviour_effects
+
+    @actor
+    class A(Actor):
+        n: I32
+        MAX_BLOBS = 2
+
+        @behaviour
+        def go(self, st):
+            self.blob_alloc()
+            self.blob_alloc(length=1)
+            return st
+
+    eff = behaviour_effects(A.go)
+    assert eff.blob_allocs == 2
+    assert "allocs blobs×2" in eff.marks()
