@@ -74,4 +74,23 @@ for name, kw in [
         run_variant(name, pings=pings, cap=cap, **kw)
     except Exception as e:                    # noqa: BLE001
         note(f"{name} FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+# Blob-pipeline throughput (models/records at scale): the rich-payload
+# path's on-chip cost — alloc/write/migrate-free dispatch + pool churn.
+# First full run warms the jit cache (same world shapes); the timed run
+# is a FRESH world so only warm execution is measured, like the
+# best-of-N rows above.
+try:
+    from ponyc_tpu.models import records
+
+    n_src, n_per = 4096, 8
+    records.run_records(n_sources=n_src, n_records=n_per)   # warm/compile
+    t1 = time.time()
+    rt, st = records.run_records(n_sources=n_src, n_records=n_per)
+    el = time.time() - t1
+    n_rec = n_src * n_per
+    note(f"records[{n_src}x{n_per}] warm {el:.2f}s = "
+         f"{n_rec / el:.3e} records/s (steps {rt.steps_run})")
+except Exception as e:                        # noqa: BLE001
+    note(f"records FAILED: {type(e).__name__}: {str(e)[:300]}")
 note("FUSED_DONE")
