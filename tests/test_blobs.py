@@ -685,3 +685,31 @@ def test_verify_marks_blob_allocs():
     eff = behaviour_effects(A.go)
     assert eff.blob_allocs == 2
     assert "allocs blobs×2" in eff.marks()
+
+
+def test_snapshot_resumes_midflight_blob_pipeline():
+    # Checkpoint while blob messages are QUEUED (allocated, unread),
+    # restore into a fresh runtime, run to completion: totals exact,
+    # pool leak-free — the blob arrays ride the generic state pytree.
+    from ponyc_tpu import serialise
+    from ponyc_tpu.models import records
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=2, max_sends=2,
+                          msg_words=2, inject_slots=8,
+                          blob_slots=128, blob_words=records.W)
+    rt, sink, sources = records.build(8, 6, opts)
+    for s in sources:
+        rt.send(int(s), records.RecSource.emit, 0)
+    rt.run(max_steps=3)                     # mid-flight: blobs queued
+    assert rt.blobs_in_use > 0
+    path = "/tmp/test_blob_midflight.npz"
+    serialise.save(rt, path)
+
+    rt2, sink2, _ = records.build(8, 6, opts)
+    serialise.restore(rt2, path)
+    rt2.run()
+    want_n, want_total = records.oracle(8, 6)
+    st = rt2.state_of(int(sink2))
+    assert st["n"] == want_n
+    assert np.int32(st["total"]) == np.int32(want_total)
+    assert rt2.blobs_in_use == 0
