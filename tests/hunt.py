@@ -116,6 +116,14 @@ FIFO_CONFIGS = {
     "mesh4-bucket": dict(mailbox_cap=2, batch=1, max_sends=3,
                          spill_cap=8192, inject_slots=32, mesh_shards=4,
                          route_bucket=8, quiesce_interval=2),
+    # blob-bind:* rows run the payload<->message BINDING fifo variant
+    # (run_blob_fifo): stamps ride both a word and the blob.
+    "blob-bind:tiny": dict(mailbox_cap=2, batch=1, max_sends=2,
+                           spill_cap=4096, inject_slots=16),
+    "blob-bind:mesh4": dict(mailbox_cap=2, batch=1, max_sends=2,
+                            spill_cap=8192, inject_slots=32,
+                            mesh_shards=4, route_bucket=4,
+                            quiesce_interval=2),
 }
 
 
@@ -126,11 +134,14 @@ def main_fifo(n_seeds, first):
     fails the seed."""
     import test_fifo as tf
 
-    def run_seed(seed, _cfg, kw):
+    def run_seed(seed, cfg, kw):
         rng = np.random.default_rng(seed)
         n_cons = int(rng.integers(3, 12))
         items = int(rng.integers(20, 90))
-        tf.run_fifo(seed, kw, n_cons=n_cons, items=items)
+        if cfg.startswith("blob-bind:"):
+            tf.run_blob_fifo(seed, kw, n_cons=n_cons, items=items)
+        else:
+            tf.run_fifo(seed, kw, n_cons=n_cons, items=items)
         return f", n_cons={n_cons}, items={items}"
     return _marathon(n_seeds, first, FIFO_CONFIGS, run_seed, "fifo")
 

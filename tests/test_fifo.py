@@ -215,3 +215,105 @@ def test_host_consumer_fifo():
     for e in range(n_prod):
         assert logs.get(e) == list(range(items)), (e, (logs.get(e)
                                                        or [])[:10])
+
+
+# --- blob payload↔message binding under order stress -------------------
+# The commutative blob differential cannot see a PAYLOAD SWAP between
+# two in-flight messages (the multiset of values survives); here every
+# message carries its sequence stamp BOTH in a payload word and inside
+# its blob, and the consumer checks on device that (a) per-edge stamps
+# stay contiguous (FIFO) and (b) blob stamp == word stamp (binding) —
+# through tiny-cap spills and, on a mesh, through migration.
+
+@actor
+class BlobProd:
+    c1: "Ref[BlobCons]"
+    slot1: I32
+    seq: I32
+
+    MAX_SENDS = 2
+    MAX_BLOBS = 1
+    BLOB_DISPATCHES = 1
+    BATCH = 1
+
+    @behaviour
+    def produce(self, st, n: I32):
+        from ponyc_tpu import Blob  # noqa: F401
+        go = n > 0
+        h = self.blob_alloc(length=2, when=go)
+        self.blob_set(h, 0, st["seq"], when=go)
+        self.blob_set(h, 1, self.actor_id, when=go)
+        self.send(st["c1"], BlobCons.consume, st["slot1"], st["seq"], h,
+                  when=go)
+        self.send(self.actor_id, BlobProd.produce, n - 1, when=n > 1)
+        return {**st, "seq": st["seq"] + (n > 0) * np.int32(1)}
+
+
+@actor
+class BlobCons:
+    last0: I32
+    last1: I32
+    got: I32
+    bad: I32          # FIFO violations (stamp not contiguous per edge)
+    badbind: I32      # payload/message binding violations
+
+    @behaviour
+    def consume(self, st, slot: I32, seq: I32, h: "Blob"):
+        bseq = self.blob_get(h, 0)
+        self.blob_free(h)
+        upd = dict(st)
+        upd["badbind"] = st["badbind"] + (bseq != seq)
+        viol = np.int32(0)
+        for s in range(2):
+            is_s = slot == s
+            last = st[f"last{s}"]
+            upd[f"last{s}"] = last + (seq - last) * is_s
+        viol = sum((slot == s) & (seq != st[f"last{s}"] + 1)
+                   for s in range(2))
+        upd["bad"] = st["bad"] + viol
+        upd["got"] = st["got"] + 1
+        return upd
+
+
+def run_blob_fifo(seed, okw, n_cons=4, items=30):
+    rng = np.random.default_rng(seed)
+    n_prod = 2 * n_cons                  # exactly two edges per consumer
+    perm = rng.permutation(n_prod)
+    cons_of = np.repeat(np.arange(n_cons), 2)[perm]
+    slot_of = np.tile(np.arange(2), n_cons)[perm]
+    opts = RuntimeOptions(msg_words=3,
+                          blob_slots=max(256, n_prod * items),
+                          blob_words=2, **okw)
+    rt = Runtime(opts)
+    rt.declare(BlobProd, n_prod).declare(BlobCons, n_cons)
+    rt.start()
+    cids = rt.spawn_many(BlobCons, n_cons,
+                         last0=np.full(n_cons, -1, np.int32),
+                         last1=np.full(n_cons, -1, np.int32))
+    pids = rt.spawn_many(BlobProd, n_prod,
+                         c1=cids[cons_of], slot1=slot_of.astype(np.int32))
+    rt.bulk_send(pids, BlobProd.produce, np.full(n_prod, items, np.int32))
+    assert rt.run(max_steps=500_000) == 0, "must quiesce"
+    st = rt.cohort_state(BlobCons)
+    assert not np.asarray(st["badbind"][:n_cons]).any(), (
+        "payload/message binding violated", np.asarray(st["badbind"]))
+    assert not np.asarray(st["bad"][:n_cons]).any(), (
+        "FIFO violated", np.asarray(st["bad"]))
+    for s in range(2):
+        assert (np.asarray(st[f"last{s}"][:n_cons]) == items - 1).all()
+    assert (np.asarray(st["got"][:n_cons]) == 2 * items).all()
+    assert rt.blobs_in_use == 0
+    return rt
+
+
+@pytest.mark.parametrize("name,okw", [
+    ("tiny", dict(mailbox_cap=2, batch=1, max_sends=2, spill_cap=2048,
+                  inject_slots=16)),
+    ("cosort", dict(mailbox_cap=4, batch=2, max_sends=2, spill_cap=2048,
+                    inject_slots=16, delivery="cosort")),
+    ("mesh4-bucket", dict(mailbox_cap=2, batch=1, max_sends=2,
+                          spill_cap=4096, inject_slots=32, mesh_shards=4,
+                          route_bucket=4, quiesce_interval=2)),
+])
+def test_blob_payload_binding_fifo(name, okw):
+    run_blob_fifo(11, okw)
