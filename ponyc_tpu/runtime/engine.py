@@ -1229,9 +1229,25 @@ def build_step(program: Program, opts: RuntimeOptions):
         if blob_en:
             bsl = opts.blob_slots
             bbase = shard * bsl
-            bperm, bvfree, _ = compact_mask(~st.blob_used, bsl)
-            free_blob = jnp.where(bvfree, bbase + bperm.astype(jnp.int32),
-                                  jnp.int32(-1))
+            # Idle costs nothing (the fork's thesis, README.md:8-10):
+            # the free-slot compaction feeds only reservation windows,
+            # and no window is READ unless an allocating cohort
+            # dispatches — so skip the sort when none has queued work.
+            alloc_busy = jnp.bool_(False)
+            for _ch in dev_cohorts:
+                if _ch.blob_sites and _ch.blob_dispatches:
+                    _sl = slice(_ch.local_start, _ch.local_stop)
+                    alloc_busy = alloc_busy | jnp.any(
+                        runnable[_sl] & (occ0[_sl] > 0))
+
+            def _compact_free(_):
+                bperm, bvfree, _n = compact_mask(~st.blob_used, bsl)
+                return jnp.where(bvfree,
+                                 bbase + bperm.astype(jnp.int32),
+                                 jnp.int32(-1))
+            free_blob = lax.cond(
+                alloc_busy, _compact_free,
+                lambda _: jnp.full((bsl,), -1, jnp.int32), operand=None)
         blob_cur = (st.blob_data, st.blob_used, st.blob_len, st.blob_gen)
         blob_fail = st.blob_fail[0]
         nb_alloc = jnp.int32(0)
