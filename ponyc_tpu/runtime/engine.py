@@ -121,9 +121,12 @@ def eval_behaviour(bdef, st, payload, ids_vec, *, msg_words: int,
                   spawn_meta=spawn_meta, blob=blob)
     args = pack.unpack_args(bdef.arg_specs, payload)
     if blob is not None:
-        # Blob handles are shard-local in v1 (state.py layout): a handle
-        # delivered across the mesh reads as null (-1) and counts — the
-        # defined remote semantics, ≙ nothing (the reference runtime is
+        # Blob handles are dereferenceable only on their pool's shard;
+        # migration (engine._route) re-homes payloads with their routed
+        # messages, so mailbox handles are local by the time they
+        # dispatch. The residue — host injections without near=, or
+        # migration drops — reads as null (-1) and counts: defined,
+        # loud, never a wrong read. ≙ nothing in the reference (it is
         # single-node; there is no remote heap to dereference).
         nulled = []
         for spec, a in zip(bdef.arg_specs, args):

@@ -263,10 +263,11 @@ def build_gc(program: Program, opts: RuntimeOptions):
         # a pool slot stays allocated iff a surviving actor's Blob FIELD
         # holds it, a queued/spilled message's Blob ARG carries it, or
         # the host declared it a root (rt.blob_store handles not yet
-        # sent). Marking is shard-LOCAL on purpose: handles are only
-        # dereferenceable on their owning shard (v1 shard-local blobs),
-        # so a handle that was moved off-shard — unreachable by
-        # construction — is collected here, closing that leak.
+        # sent). Marking is shard-LOCAL on purpose: migration
+        # (engine._route) re-homes a payload WITH its routed message,
+        # so every resting reachable handle is local to its pool's
+        # shard; the rare off-shard handle (host injection without
+        # near=, migration drop) is undereferenceable and collects.
         n_swept = jnp.int32(0)
         blob_used2, blob_len2 = st.blob_used, st.blob_len
         nbf2 = st.n_blob_free
