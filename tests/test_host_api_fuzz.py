@@ -86,3 +86,84 @@ def test_random_host_op_sequences_keep_invariants(seed, tmp_path):
     assert rt.run(max_steps=50_000) == 0
     rt.check_invariants()
     assert not np.asarray(rt.state.muted).any()
+
+
+@pytest.mark.parametrize("seed", [5, 23, 91])
+def test_random_host_blob_op_sequences_match_model(seed):
+    """Host blob surface fuzz: random store/fetch/free/send/run
+    sequences against a python MODEL of the pool; stale fetches and
+    double frees must reject exactly when the model says the handle is
+    dead (even after slot recycling — generation mismatch), gc must
+    reclaim exactly the unrooted unreferenced slots, and counters must
+    reconcile."""
+    from ponyc_tpu import Blob
+
+    @actor
+    class Sink:
+        total: I32
+
+        @behaviour
+        def eat(self, st, h: Blob):
+            st["total"] = st["total"] + self.blob_get(h, 0)
+            self.blob_free(h)
+            return st
+
+    rng = np.random.default_rng(seed)
+    BSL = 6
+    rt = Runtime(RuntimeOptions(mailbox_cap=4, batch=2, msg_words=2,
+                                max_sends=1, spill_cap=64,
+                                inject_slots=8,
+                                blob_slots=BSL, blob_words=2))
+    rt.declare(Sink, 2).start()
+    sink = rt.spawn(Sink, total=0)
+    model = {}           # handle -> word0 (host-rooted, alive)
+    dead = []            # handles the model says are gone (moved/freed)
+    eaten = 0
+    for _ in range(120):
+        op = rng.random()
+        if op < 0.35:                      # store (may exhaust)
+            v = int(rng.integers(0, 1000))
+            in_use = rt.blobs_in_use
+            from ponyc_tpu import BlobCapacityError
+            try:
+                h = rt.blob_store([v])
+                assert in_use < BSL, "store succeeded on a full pool"
+                model[h] = v
+            except BlobCapacityError:
+                assert in_use == BSL, (in_use, BSL)
+        elif op < 0.50 and model:          # fetch a live handle
+            h = int(rng.choice(list(model)))
+            assert int(rt.blob_fetch(h)[0]) == model[h]
+        elif op < 0.60 and dead:           # poke a DEAD handle: both
+            h = int(rng.choice(dead))      # fetch and double-free must
+            if h not in model:             # reject, even after the slot
+                #                            recycled (gen mismatch)
+                with pytest.raises((KeyError, IndexError)):
+                    rt.blob_fetch(h)
+                with pytest.raises((KeyError, IndexError)):
+                    rt.blob_free_host(h)
+        elif op < 0.72 and model:          # free
+            h = int(rng.choice(list(model)))
+            rt.blob_free_host(h)
+            del model[h]
+            dead.append(h)
+        elif op < 0.85 and model:          # send to the sink (move)
+            h = int(rng.choice(list(model)))
+            rt.send(sink, Sink.eat, h)
+            rt.run(max_steps=6)            # sink eats + frees
+            with pytest.raises((KeyError, IndexError)):
+                rt.blob_fetch(h)           # consumed: handle now dead
+            eaten += model.pop(h)
+            dead.append(h)
+        else:                              # settle + audit
+            rt.run(max_steps=4)
+            rt.gc()
+            # Exactly the rooted handles survive collection.
+            assert rt.blobs_in_use == len(model), (
+                rt.blobs_in_use, model)
+            for h, v in model.items():
+                assert int(rt.blob_fetch(h)[0]) == v
+    rt.run(max_steps=10)
+    assert rt.state_of(sink)["total"] == eaten
+    stats = (rt.counter("n_blob_alloc"), rt.counter("n_blob_free"))
+    assert stats[0] - stats[1] == rt.blobs_in_use
