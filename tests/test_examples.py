@@ -1,0 +1,25 @@
+"""Smoke-run the self-contained example programs (≙ the reference's
+examples/ being part of its CI surface): each main() must complete its
+own asserts. Net/terminal examples need sockets/tty and are exercised
+by their dedicated suites (test_net*, test_bridge) instead."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def test_spreader_tree():
+    import spreader
+    assert spreader.main(4) == 0
+
+
+def test_heartbeat_timers():
+    import heartbeat
+    assert heartbeat.main() == 0
+
+
+def test_blob_pipeline():
+    import blob_pipeline
+    assert blob_pipeline.main() == 0
