@@ -141,9 +141,10 @@ elif VARIANT == "nodisp":
         inner = real_cd(cohort, opts, noyield, program)
 
         def run_cohort(ts, buf_rows, head_rows, occ_rows, runnable_rows,
-                       ids, resv):
+                       ids, resv, blob=None):
             out = inner(ts, buf_rows, head_rows, occ_rows,
-                        jnp.zeros_like(runnable_rows), ids, resv)
+                        jnp.zeros_like(runnable_rows), ids, resv,
+                        blob=blob)
             return out
         return run_cohort
     engine._cohort_dispatch = patched_cd

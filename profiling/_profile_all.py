@@ -129,9 +129,10 @@ def patch_nodisp():
         inner = real_cd(cohort, opts, noyield, program)
 
         def run_cohort(ts, buf_rows, head_rows, occ_rows, runnable_rows,
-                       ids, resv):
+                       ids, resv, blob=None):
             return inner(ts, buf_rows, head_rows, occ_rows,
-                         jnp.zeros_like(runnable_rows), ids, resv)
+                         jnp.zeros_like(runnable_rows), ids, resv,
+                         blob=blob)
         return run_cohort
     engine._cohort_dispatch = patched_cd
     return real_cd
