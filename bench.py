@@ -29,9 +29,22 @@ back to CPU — loudly, with the TPU error in the JSON detail — so a run
 always captures a parseable result. Set PONY_TPU_BENCH_ALLOW_CPU=0 to
 make TPU-init failure fatal instead, or --platform cpu for smoke runs.
 
+Delivery/dispatch formulation defaults to "auto": Runtime.start()
+calibrates every eligible variant in-executable (ponyc_tpu/tuning.py),
+the JSON gains a `tuning` block with the per-variant tick_ms table, and
+the decision persists in the on-disk tuning cache (steady-state runs
+skip calibration). The jax persistent compile cache is enabled too on
+accelerator backends (CPU reload is unsound on jaxlib 0.4.37 —
+PROFILE.md §6), so a second identical run's warmup_s drops to
+executable-reload time.
+
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
+                        [--delivery auto|plan|cosort] [--fused auto|on|off]
 Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
-       PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU override.
+       PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU /
+       PONY_TPU_BENCH_DELIVERY / PONY_TPU_BENCH_FUSED override;
+       PONY_TPU_TUNING_CACHE / PONY_TPU_COMPILE_CACHE relocate ("off"
+       disables) the persistent caches.
 """
 
 import argparse
@@ -89,6 +102,14 @@ def force_cpu():
     _force()
 
 
+def tristate(v):
+    """CLI/env spelling of a bool-or-"auto" runtime option."""
+    v = str(v).lower()
+    if v == "auto":
+        return "auto"
+    return v in ("1", "true", "yes", "on")
+
+
 def bench_ubench(args):
     import jax
     import jax.numpy as jnp
@@ -103,7 +124,8 @@ def bench_ubench(args):
     opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
                           msg_words=1, spill_cap=1024, inject_slots=8,
                           delivery=args.delivery,
-                          pallas_fused=args.fused)
+                          pallas=tristate(args.pallas),
+                          pallas_fused=tristate(args.fused))
     t0 = time.time()
     rt, ids = ubench.build(args.actors, opts, pings=pings)
     ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)  # ~infinite
@@ -146,10 +168,16 @@ def bench_ubench(args):
         "processed_counter_ok": bool(processed == expect % (1 << 32)),
         "build_s": build_s,
         "warmup_s": warm_s,
+        # The A/B record: what "auto" measured and picked (tuning.py);
+        # None when every formulation flag was forced.
+        "tuning": rt.tuning_record,
+        "delivery": rt.opts.delivery,
+        "pallas": rt.opts.pallas,
+        "pallas_fused": rt.opts.pallas_fused,
     }
 
 
-def bench_latency(args):
+def bench_latency(args, delivery="plan", fused=False):
     """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
     one hop per tick. The headline number is the DEVICE-RESIDENT per-hop
     latency — window-of-K hops in one fused dispatch, divided by K — the
@@ -162,10 +190,12 @@ def bench_latency(args):
     from ponyc_tpu import RuntimeOptions
     from ponyc_tpu.models import ring
 
+    # The latency ring reuses the headline run's RESOLVED formulation
+    # (auto calibrating again on the tiny ring layout would measure the
+    # wrong program and pay a second calibration).
     opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
                           spill_cap=64, inject_slots=8,
-                          delivery=args.delivery,
-                          pallas_fused=args.fused)
+                          delivery=delivery, pallas_fused=fused)
     rt, ids = ring.build(args.lat_actors, opts)
     rt.send(int(ids[0]), ring.RingNode.token, 1 << 30)
     inj = rt._drain_inject()
@@ -221,11 +251,20 @@ def main():
                     default=int(os.environ.get("PONY_TPU_BENCH_PINGS", 4)))
     ap.add_argument("--delivery",
                     default=os.environ.get("PONY_TPU_BENCH_DELIVERY",
-                                           "plan"),
-                    choices=["plan", "cosort"])
-    ap.add_argument("--fused", action="store_true",
-                    default=os.environ.get("PONY_TPU_BENCH_FUSED",
-                                           "0") not in ("0", ""))
+                                           "auto"),
+                    choices=["plan", "cosort", "auto"],
+                    help="delivery formulation; 'auto' (default) "
+                    "calibrates plan vs cosort in-executable at start "
+                    "and records the table in the JSON (tuning.py)")
+    ap.add_argument("--fused", nargs="?", const="on",
+                    default=os.environ.get("PONY_TPU_BENCH_FUSED", "0"),
+                    choices=["on", "off", "auto", "0", "1"],
+                    help="fused Pallas dispatch: on/off/auto "
+                    "(auto adds it to the calibrated variants)")
+    ap.add_argument("--pallas", nargs="?", const="on",
+                    default=os.environ.get("PONY_TPU_BENCH_PALLAS", "0"),
+                    choices=["on", "off", "auto", "0", "1"],
+                    help="Pallas drain kernel: on/off/auto")
     ap.add_argument("--lat-actors", type=int, default=1024)
     ap.add_argument("--lat-ticks", type=int, default=200)
     ap.add_argument("--platform",
@@ -269,8 +308,15 @@ def main():
     import jax
     plat = jax.devices()[0].platform
 
+    # Persistent compile cache (tuning.enable_compile_cache): the
+    # second run of an identical bench reloads its executables instead
+    # of re-lowering — the warmup_s delta is the measurement.
+    from ponyc_tpu import tuning as _tuning
+    compile_cache = _tuning.enable_compile_cache()
+
     ub = bench_ubench(args)
-    lat = bench_latency(args)
+    lat = bench_latency(args, delivery=ub["delivery"],
+                        fused=ub["pallas_fused"])
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -282,8 +328,10 @@ def main():
             "actors": args.actors,
             "ticks": ub["ticks"],
             "pings": ub["pings"],
-            "delivery": args.delivery,
-            "pallas_fused": args.fused,
+            "delivery": ub["delivery"],
+            "delivery_requested": args.delivery,
+            "pallas": ub["pallas"],
+            "pallas_fused": ub["pallas_fused"],
             "fused_ticks_per_dispatch": ub["fuse"],
             "elapsed_s": round(ub["elapsed_s"], 4),
             "tick_ms": round(ub["tick_ms"], 3),
@@ -296,7 +344,11 @@ def main():
             "host_roundtrip_us": round(lat["host_roundtrip_us"], 1),
             "latency_ring_actors": args.lat_actors,
             "latency_hops_ok": lat["hops_ok"],
+            "compile_cache": compile_cache,
         },
+        # In-executable tick_ms per eligible variant + the decision —
+        # every bench run IS the A/B record (PROFILE.md §6).
+        "tuning": ub["tuning"],
     }
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error

@@ -279,10 +279,11 @@ class BlobPoolView:
     (pony.h:332-360): alloc on the owning actor, move by message."""
 
     __slots__ = ("data", "used", "len_", "gen", "base", "nslots", "take",
-                 "resv", "claims", "fail", "n_alloc", "n_free",
-                 "n_remote", "alloced")
+                 "resv", "claims", "fail", "budget_fail", "n_alloc",
+                 "n_free", "n_remote", "alloced", "budget_over")
 
-    def __init__(self, data, used, len_, gen, base, take, resv):
+    def __init__(self, data, used, len_, gen, base, take, resv,
+                 budget_over=None):
         self.data = data            # [W, B] i32 (working copy)
         self.used = used            # [B] bool
         self.len_ = len_            # [B] i32
@@ -292,7 +293,13 @@ class BlobPoolView:
         self.take = take            # [lanes] bool
         self.resv = resv            # [sites, lanes] i32 handles, or None
         self.claims = 0             # trace-time alloc-site counter
-        self.fail = jnp.bool_(False)     # sticky: wanted a slot, got -1
+        self.fail = jnp.bool_(False)     # sticky: wanted a slot, pool empty
+        self.budget_fail = jnp.bool_(False)  # sticky: wanted a slot but
+        #   the dispatch was past its BLOB_DISPATCHES reservation budget
+        self.budget_over = budget_over   # [lanes] bool or None — lanes
+        #   whose reservation window was withheld for budget (engine's
+        #   used-counter walk), used to blame alloc failures on the
+        #   right knob (blob_slots vs BLOB_DISPATCHES)
         self.n_alloc = jnp.int32(0)
         self.n_free = jnp.int32(0)
         self.n_remote = jnp.int32(0)     # Blob args that arrived off-shard
@@ -678,7 +685,16 @@ class Context:
         b.claims += 1
         w = jnp.asarray(when, jnp.bool_)
         ok = w & b.take & (slot >= 0)
-        b.fail = b.fail | jnp.any(w & b.take & (slot < 0))
+        wanted = w & b.take & (slot < 0)
+        # Blame the right knob: a lane whose whole reservation window
+        # was withheld (dispatch count past BLOB_DISPATCHES) failed on
+        # BUDGET; a lane holding a real window that still read -1 found
+        # the POOL's compacted free list exhausted.
+        if b.budget_over is not None:
+            b.budget_fail = b.budget_fail | jnp.any(wanted & b.budget_over)
+            b.fail = b.fail | jnp.any(wanted & ~b.budget_over)
+        else:
+            b.fail = b.fail | jnp.any(wanted)
         idx = jnp.where(ok, slot - b.base, b.nslots)  # OOB-high → dropped
         # Bump the slot generation and bake it into the handle (ABA
         # guard): any still-circulating handle from the slot's previous

@@ -1,0 +1,33 @@
+"""JAX version compatibility shims.
+
+The repo targets the jax_graft toolchain image, whose pinned JAX moves
+APIs between releases. Every site that depends on a moved symbol goes
+through here so a version bump is one edit, not a grep.
+
+Currently shimmed:
+
+- ``shard_map``: ``jax.shard_map`` (new spelling, with ``check_vma``)
+  vs ``jax.experimental.shard_map.shard_map`` (JAX <= 0.4.x, with
+  ``check_rep``). Both disable the replication/VMA check the engine's
+  shard-divergent cond predicates would trip.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` with replication checking off.
+
+    The engine's per-shard step uses shard-divergent ``lax.cond``
+    predicates (idle cohorts, pressure paths) that the static
+    replication checker rejects; both spellings of the checker flag
+    (``check_vma`` new, ``check_rep`` old) are therefore disabled.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)

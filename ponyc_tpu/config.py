@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import sys
-from typing import List, Optional
+from typing import List, Optional, Union
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,15 +106,17 @@ class RuntimeOptions:
     analysis_path: str = "/tmp/pony_tpu.analytics.csv"
     analysis_events: int = 4096    # device event-ring entries per shard
     #   (level 3); overflow between two drains drops and counts
-    pallas: bool = False           # route the dispatch mailbox drain
+    pallas: Union[bool, str] = False   # route the dispatch mailbox drain
     #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
-    #   XLA select-chain; interpret-mode on CPU. Off until measured
-    #   faster on the real chip.
-    pallas_fused: bool = False     # fuse drain + behaviour + outbox into
-    #   ONE Pallas kernel per eligible cohort (ops/fused_dispatch.py:
-    #   single behaviour, no spawns/destroy/error/sync-construction;
-    #   others fall back to the XLA path). The north-star dispatch
-    #   kernel; off until measured on the real chip.
+    #   XLA select-chain; interpret-mode on CPU. "auto" adds the kernel
+    #   as a calibrated variant (tuning.py) where the program's cohorts
+    #   are block-aligned; the measured winner is used.
+    pallas_fused: Union[bool, str] = False  # fuse drain + behaviour +
+    #   outbox into ONE Pallas kernel per eligible cohort
+    #   (ops/fused_dispatch.py: no sync-construction/blob pool; others
+    #   fall back to the XLA path). The north-star dispatch kernel;
+    #   "auto" = calibrate it against the XLA path at start() and keep
+    #   the winner (tuning.py).
     host_fastpath: bool = True     # host-sender → host-target messages
     #   bypass the device mailbox table: they queue host-side and
     #   dispatch at host boundaries (≙ the main-thread scheduler's
@@ -141,10 +143,36 @@ class RuntimeOptions:
     #              the sort when traffic shape repeats);
     #   "cosort" — one stable multi-operand lax.sort per tick that moves
     #              the payload with the key (no plan, no gathers; wins
-    #              where arbitrary lane gathers lower poorly).
+    #              where arbitrary lane gathers lower poorly);
+    #   "auto"   — calibrate both at Runtime.start() by timing a short
+    #              in-executable fused window per formulation on the
+    #              program's real cohort shapes and keep the faster one
+    #              (tuning.py; the decision persists in the tuning
+    #              cache so steady-state starts skip calibration).
     debug_checks: bool = False     # run Runtime.check_invariants() at
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)
+
+    # --- autotuning / caches (tuning.py; ≙ nothing in the reference —
+    # its dispatch is one fixed O(1) switch, genfun.c; ours has
+    # formulation choices whose winner is hardware- and shape-dependent,
+    # so the runtime measures instead of a human with a scratch script:
+    # PROFILE.md §6) ---
+    tuning_cache: str = "auto"     # on-disk decision cache for "auto"
+    #   option values, keyed by (platform, jax version, cohort layout,
+    #   geometry). "auto" = $PONY_TPU_TUNING_CACHE or
+    #   ~/.cache/ponyc_tpu/tuning; "off" disables (recalibrate every
+    #   start); any other value = explicit directory.
+    compile_cache: str = "auto"    # jax persistent compilation cache
+    #   (attacks the measured 11.8 s warmup, PROFILE.md §4b). Same
+    #   spelling: "auto" = $PONY_TPU_COMPILE_CACHE or
+    #   ~/.cache/ponyc_tpu/xla; "off" leaves jax.config untouched.
+    tuning_ticks: int = 0          # in-executable ticks per calibration
+    #   window (lax.fori_loop trip count — the only methodology
+    #   PROFILE.md §4b trusts; per-call timings carry an ~11 ms launch
+    #   floor). 0 = auto-size from the synthetic workload's sustain.
+    tuning_repeats: int = 3        # timed windows per variant (the
+    #   median is kept; the first, compile-bearing window never counts)
 
     # --- device blob pool (≙ rich message payloads: pony_alloc_msg +
     # actor-heap objects riding messages, pony.h:332-360 / genfun.c.
@@ -177,8 +205,16 @@ class RuntimeOptions:
             raise ValueError("msg_words must be >= 1")
         if self.batch < 1:
             raise ValueError("batch must be >= 1")
-        if self.delivery not in ("plan", "cosort"):
-            raise ValueError("delivery must be 'plan' or 'cosort'")
+        if self.delivery not in ("plan", "cosort", "auto"):
+            raise ValueError("delivery must be 'plan', 'cosort' or 'auto'")
+        for name in ("pallas", "pallas_fused"):
+            v = getattr(self, name)
+            if not (v is True or v is False or v == "auto"):
+                raise ValueError(f"{name} must be True, False or 'auto'")
+        if self.tuning_repeats < 1:
+            raise ValueError("tuning_repeats must be >= 1")
+        if self.tuning_ticks < 0:
+            raise ValueError("tuning_ticks must be >= 0 (0 = auto)")
         if self.blob_slots < 0 or self.blob_words < 0:
             raise ValueError("blob_slots/blob_words must be >= 0")
         if (self.blob_slots > 0) != (self.blob_words > 0):
@@ -202,9 +238,20 @@ class RuntimeOptions:
 
 _FLAG_TYPES = {f.name: f.type for f in dataclasses.fields(RuntimeOptions)}
 
+# bool-or-"auto" tri-state flags: bare flag spells True, "auto" survives
+# coercion (everything else parses like a bool).
+_TRISTATE = ("pallas", "pallas_fused")
+
+
+def _is_boolish(name: str) -> bool:
+    return name in _TRISTATE or _FLAG_TYPES[name] in ("bool", bool)
+
 
 def _coerce(name: str, raw: str):
     ty = _FLAG_TYPES[name]
+    if name in _TRISTATE:
+        return "auto" if raw.lower() == "auto" else (
+            raw.lower() in ("1", "true", "yes", "on", ""))
     if ty in ("bool", bool):
         return raw.lower() in ("1", "true", "yes", "on", "")
     if ty in ("int", int, "Optional[int]", Optional[int]):
@@ -248,7 +295,7 @@ def strip_runtime_flags(argv: Optional[List[str]] = None,
             if key in canon:
                 name = canon[key]
                 if raw is None:
-                    if _FLAG_TYPES[name] in ("bool", bool):
+                    if _is_boolish(name):
                         raw = "true"
                     else:
                         i += 1
@@ -262,3 +309,11 @@ def strip_runtime_flags(argv: Optional[List[str]] = None,
         i += 1
     base = options_from_env(base)
     return dataclasses.replace(base, **overrides), rest
+
+
+def auto_fields(opts: RuntimeOptions) -> List[str]:
+    """Option fields whose value is the "auto" sentinel — the set the
+    tuner (tuning.py) must resolve to concrete values before the engine
+    traces (the engine only ever sees concrete formulations)."""
+    return [n for n in ("delivery", "pallas", "pallas_fused")
+            if getattr(opts, n) == "auto"]

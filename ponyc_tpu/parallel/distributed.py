@@ -48,6 +48,15 @@ def initialize(coordinator: Optional[str] = None,
     process_id = int(process_id
                      if process_id is not None
                      else os.environ.get("JAX_PROCESS_ID", 0))
+    # The CPU backend refuses cross-process computations ("Multiprocess
+    # computations aren't implemented on the CPU backend") unless a
+    # collectives implementation is selected; gloo ships with jaxlib.
+    # Must land BEFORE the backend initialises — harmless for
+    # accelerator backends, which ignore the knob.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass          # the knob moved (older/newer jax): leave default
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -58,8 +58,12 @@ class StepAux(NamedTuple):
     exit_code: jnp.ndarray       # int32
     spill_overflow: jnp.ndarray  # bool — fatal: a spill buffer exceeded
     spawn_fail: jnp.ndarray      # bool — fatal: ctx.spawn found no slot
-    blob_fail: jnp.ndarray       # bool — fatal: ctx.blob_alloc found no
-    #   free pool slot (≙ pony_alloc exhausting the heap)
+    blob_fail: jnp.ndarray       # bool — fatal: ctx.blob_alloc found the
+    #   POOL exhausted (≙ pony_alloc exhausting the heap; raise
+    #   RuntimeOptions.blob_slots)
+    blob_budget_fail: jnp.ndarray  # bool — fatal: ctx.blob_alloc ran
+    #   past the actor's per-tick BLOB_DISPATCHES reservation budget
+    #   (free slots may remain; raise the class's BLOB_DISPATCHES)
     any_muted: jnp.ndarray       # bool — some actor still carries a mute
     #   flag; run() uses it for bounded CLEANUP ticks at quiescence so a
     #   terminated world ends unmuted (the unmute pass lags the drain
@@ -284,12 +288,13 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
             # see api.BlobPoolView for why no cross-branch select is
             # needed; resv row may be zero-sites for receive-only types.)
             from ..api import BlobPoolView
-            bdata, bused, blen, bgen, bbase, bresv = blob_in
+            bdata, bused, blen, bgen, bbase, bresv, bover = blob_in
             bv = BlobPoolView(bdata, bused, blen, bgen, bbase,
                               (take if take is not None
                                else jnp.ones((lanes,), jnp.bool_)),
                               bresv if (bresv is not None
-                                        and bresv.shape[0]) else None)
+                                        and bresv.shape[0]) else None,
+                              budget_over=bover)
         ctx, st2, tgts, words = eval_behaviour(
             bdef, st, payload, ids_vec, msg_words=msg_words,
             field_specs=field_specs, field_dtypes=field_dtypes,
@@ -331,7 +336,8 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
         blob_out = None
         if bv is not None:
             blob_out = (bv.data, bv.used, bv.len_, bv.gen, bv.fail,
-                        bv.n_alloc, bv.n_free, bv.n_remote,
+                        bv.budget_fail, bv.n_alloc, bv.n_free,
+                        bv.n_remote,
                         _bcast_lanes(bv.alloced, jnp.bool_, lanes))
         return (st2, (tgts, words),
                 (_bcast_lanes(ctx.exit_flag, b, lanes),
@@ -453,12 +459,17 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             # spawn_dispatches pattern; exhausted budget yields -1 refs
             # -> sticky blob_fail, never a double claim).
             rblob = None
+            rblob_over = None
             if blb is not None:
                 rt_b = blob["resv"]
                 rblob = jnp.full(rt_b.shape[1:], -1, jnp.int32)
                 for d in range(rt_b.shape[0]):
                     rblob = jnp.where((bused_c == d)[None, :], rt_b[d],
                                       rblob)
+                # Lanes whose window was withheld for BUDGET (allocating
+                # dispatch count past BLOB_DISPATCHES) — an alloc failure
+                # there blames the budget knob, not the pool size.
+                rblob_over = bused_c >= rt_b.shape[0]
             # Hand one dispatch-worth of spawn reservations to this batch
             # slot: a `used` counter walks the SPAWN_DISPATCHES axis;
             # exhausted budget yields -1 refs (→ sticky spawn_fail,
@@ -511,15 +522,15 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                 blob_in = None
                 if blb_a is not None:
                     blob_in = (blb_a[0], blb_a[1], blb_a[2], blb_a[3],
-                               blob["base"], rblob)
+                               blob["base"], rblob, rblob_over)
                 (st2, (btgt, bwrd), (bef, bec), byf, bclm, bini, bsf,
                  bds, (berf, berc, berl), bl_o) = br(
                     st, msg[1:], ids, resv_k, blob_in, take)
                 if blb_a is not None:
                     blb_o = (bl_o[0], bl_o[1], bl_o[2], bl_o[3],
-                             blb_a[4] | bl_o[4], blb_a[5] + bl_o[5],
+                             blb_a[4] | bl_o[4], blb_a[5] | bl_o[5],
                              blb_a[6] + bl_o[6], blb_a[7] + bl_o[7],
-                             blb_a[8] | bl_o[8])
+                             blb_a[8] + bl_o[8], blb_a[9] | bl_o[9])
                 else:
                     blb_o = None
                 st_o = {k: jnp.where(take, st2[k], st_a[k]) for k in st_a}
@@ -571,8 +582,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             (st_n, tgt_n, wrd_n, ef_n, ec_n, yf_n, sf_n, ds_n,
              erf_n, erc_n, erl_n, clm_n, ini_n, blb_acc) = acc
             if blb_acc is not None:
-                blb = blb_acc[:8]
-                bused_c = bused_c + blb_acc[8].astype(jnp.int32)
+                blb = blb_acc[:9]
+                bused_c = bused_c + blb_acc[9].astype(jnp.int32)
             spawned_here = sf_n
             for si in range(len(spawn_sites)):
                 for s in range(len(clm_n[si])):
@@ -642,8 +653,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             z = lambda d: jnp.zeros((rows,), d)         # noqa: E731
             if use_blob:
                 blb0 = (blob["data"], blob["used"], blob["len"],
-                        blob["gen"], jnp.bool_(False), jnp.int32(0),
-                        jnp.int32(0), jnp.int32(0))
+                        blob["gen"], jnp.bool_(False), jnp.bool_(False),
+                        jnp.int32(0), jnp.int32(0), jnp.int32(0))
             else:
                 blb0 = None
             carry0 = (type_state_rows, z(jnp.bool_), z(jnp.bool_),
@@ -676,8 +687,8 @@ def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
             # queued runnable messages skips gather/dispatch/outbox
             # entirely — one reduction decides.
             blb_idle = ((blob["data"], blob["used"], blob["len"],
-                         blob["gen"], jnp.bool_(False), jnp.int32(0),
-                         jnp.int32(0), jnp.int32(0))
+                         blob["gen"], jnp.bool_(False), jnp.bool_(False),
+                         jnp.int32(0), jnp.int32(0), jnp.int32(0))
                         if use_blob else None)
             return (type_state_rows,
                     jnp.full((e,), -1, jnp.int32),
@@ -1253,6 +1264,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                 lambda _: jnp.full((bsl,), -1, jnp.int32), operand=None)
         blob_cur = (st.blob_data, st.blob_used, st.blob_len, st.blob_gen)
         blob_fail = st.blob_fail[0]
+        blob_budget = st.blob_budget_fail[0]
         nb_alloc = jnp.int32(0)
         nb_free = jnp.int32(0)
         nb_remote = jnp.int32(0)
@@ -1308,9 +1320,10 @@ def build_step(program: Program, opts: RuntimeOptions):
             if blob_out is not None:
                 blob_cur = blob_out[:4]
                 blob_fail = blob_fail | blob_out[4]
-                nb_alloc = nb_alloc + blob_out[5]
-                nb_free = nb_free + blob_out[6]
-                nb_remote = nb_remote + blob_out[7]
+                blob_budget = blob_budget | blob_out[5]
+                nb_alloc = nb_alloc + blob_out[6]
+                nb_free = nb_free + blob_out[7]
+                nb_remote = nb_remote + blob_out[8]
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
             out_entries.append(out)
@@ -1632,7 +1645,7 @@ def build_step(program: Program, opts: RuntimeOptions):
                 st.n_delivered[0] + res.n_delivered,
                 occ_sum, n_muted_now, n_over_now,
                 nrej_all, nbad_all, ndl_all, nmut_all,
-                i32c(blob_fail)]), "actors")
+                i32c(blob_fail), i32c(blob_budget)]), "actors")
             spawn_fail_any = summed[0] > 0
             device_pending = summed[1] > 0
             any_muted_all = summed[2] > 0
@@ -1644,6 +1657,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             nproc_all = summed[8]
             ndel_all = summed[9]
             blob_fail_any = summed[17] > 0
+            blob_budget_any = summed[18] > 0
             if opts.analysis >= 1:
                 occ_sum, n_muted_now, n_over_now = (summed[10], summed[11],
                                                     summed[12])
@@ -1667,7 +1681,8 @@ def build_step(program: Program, opts: RuntimeOptions):
             nproc_all = st.n_processed[0] + nproc_total
             ndel_all = st.n_delivered[0] + res.n_delivered
             blob_fail_any = blob_fail
-        wb_new = (any_pressured_all.astype(jnp.int32)
+            blob_budget_any = blob_budget
+        wb_new =(any_pressured_all.astype(jnp.int32)
                   | (any_muted_all.astype(jnp.int32) << 1)
                   | (any_rspill_all.astype(jnp.int32) << 2))
 
@@ -1708,6 +1723,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             blob_data=blob_cur[0], blob_used=blob_cur[1],
             blob_len=blob_cur[2], blob_gen=blob_cur[3],
             blob_fail=vec(blob_fail, jnp.bool_),
+            blob_budget_fail=vec(blob_budget, jnp.bool_),
             n_blob_alloc=vec(st.n_blob_alloc[0] + nb_alloc),
             n_blob_free=vec(st.n_blob_free[0] + nb_free),
             n_blob_remote=vec(st.n_blob_remote[0] + nb_remote),
@@ -1722,6 +1738,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             spill_overflow=overflow_any,
             spawn_fail=spawn_fail_any,
             blob_fail=blob_fail_any,
+            blob_budget_fail=blob_budget_any,
             n_processed=nproc_all,
             n_delivered=ndel_all,
             occ_sum=occ_sum, occ_max=occ_max,
@@ -1758,7 +1775,7 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
             _st, aux, i = carry
             go = (aux.device_pending & ~aux.host_pending & ~aux.exit_flag
                   & ~aux.spill_overflow & ~aux.spawn_fail
-                  & ~aux.blob_fail)
+                  & ~aux.blob_fail & ~aux.blob_budget_fail)
             return (i == 0) | ((i < limit) & go)
 
         def body(carry):
@@ -1769,22 +1786,63 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
             s2, aux2 = step(s, it, iw)
             return (s2, aux2, i + 1)
 
-        i32, b = jnp.int32, jnp.bool_
-        aux0 = StepAux(
-            device_pending=b(True), host_pending=b(False),
-            any_muted=b(False),
-            exit_flag=b(False), exit_code=i32(0),
-            spill_overflow=b(False), spawn_fail=b(False),
-            blob_fail=b(False),
-            n_processed=i32(0), n_delivered=i32(0),
-            occ_sum=i32(0), occ_max=i32(0),
-            n_muted_now=i32(0), n_overloaded_now=i32(0),
-            n_rejected=i32(0), n_badmsg=i32(0),
-            n_deadletter=i32(0), n_mutes=i32(0))
-        stf, auxf, k = lax.while_loop(cond, body, (st, aux0, jnp.int32(0)))
+        stf, auxf, k = lax.while_loop(cond, body,
+                                      (st, zero_aux(), jnp.int32(0)))
         return stf, auxf, k
 
     return multi
+
+
+def zero_aux() -> StepAux:
+    """The pre-first-tick aux template (device_pending=True so a window's
+    while condition admits tick 0; everything else zero/false)."""
+    i32, b = jnp.int32, jnp.bool_
+    return StepAux(
+        device_pending=b(True), host_pending=b(False),
+        any_muted=b(False),
+        exit_flag=b(False), exit_code=i32(0),
+        spill_overflow=b(False), spawn_fail=b(False),
+        blob_fail=b(False), blob_budget_fail=b(False),
+        n_processed=i32(0), n_delivered=i32(0),
+        occ_sum=i32(0), occ_max=i32(0),
+        n_muted_now=i32(0), n_overloaded_now=i32(0),
+        n_rejected=i32(0), n_badmsg=i32(0),
+        n_deadletter=i32(0), n_mutes=i32(0))
+
+
+def build_forced_window(program: Program, opts: RuntimeOptions):
+    """`limit` ticks of the real step in ONE executable, unconditionally.
+
+    The calibration harness (tuning.py): a `lax.fori_loop` over
+    build_step that — unlike build_multi_step's while — ignores every
+    early-exit fact (host_pending, exit, sticky failure flags), so a
+    synthetic workload's odd corners (spawn-capable cohorts finding no
+    free slot, behaviours exiting on zero-filled state) cannot shorten
+    the trip count. Wall time / `limit` is then a trustworthy per-tick
+    cost: the only timing methodology PROFILE.md §4b admits (per-call
+    timings carry an ~11 ms launch floor on the tunnelled chip).
+    Injections are applied every tick (the tuner passes the empty
+    inject). Same signature family as build_multi_step so
+    _jit_over_mesh wraps it identically."""
+    step = build_step(program, opts)
+
+    def forced(st: RtState, inject_tgt, inject_words, limit):
+        def body(_i, carry):
+            s, _aux = carry
+            return step(s, inject_tgt, inject_words)
+
+        stf, auxf = lax.fori_loop(0, limit, body, (st, zero_aux()))
+        return stf, auxf, limit
+
+    return forced
+
+
+def jit_forced_window(program: Program, opts: RuntimeOptions, mesh=None):
+    """Jit the calibration window (extra replicated input: trip count;
+    extra replicated output: the same count, for signature symmetry
+    with jit_multi_step)."""
+    return _jit_over_mesh(build_forced_window(program, opts), program,
+                          opts, mesh, n_extra=1)
 
 
 def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
@@ -1808,11 +1866,11 @@ def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
     repl = P()
     state_spec = state_partition_specs(program, opts)
     aux_spec = StepAux(*([repl] * len(StepAux._fields)))
-    mapped = jax.shard_map(
+    from ..compat import shard_map
+    mapped = shard_map(
         fn, mesh=mesh,
         in_specs=(state_spec, repl, repl) + (repl,) * n_extra,
-        out_specs=(state_spec, aux_spec) + (repl,) * n_extra,
-        check_vma=False)
+        out_specs=(state_spec, aux_spec) + (repl,) * n_extra)
     return jax.jit(mapped, donate_argnums=(0,))
 
 

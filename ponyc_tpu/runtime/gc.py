@@ -360,6 +360,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             blob_data=st.blob_data, blob_used=blob_used2,
             blob_len=blob_len2, blob_gen=st.blob_gen,
             blob_fail=st.blob_fail,
+            blob_budget_fail=st.blob_budget_fail,
             n_blob_alloc=st.n_blob_alloc, n_blob_free=nbf2,
             n_blob_remote=st.n_blob_remote,
             n_blob_moved=st.n_blob_moved,
@@ -383,9 +384,9 @@ def jit_gc(program: Program, opts: RuntimeOptions, mesh=None):
     sharded = P("actors")
     repl = P()
     state_spec = state_partition_specs(program, opts)
-    mapped = jax.shard_map(
+    from ..compat import shard_map
+    mapped = shard_map(
         gc, mesh=mesh,
         in_specs=(state_spec, sharded, sharded),
-        out_specs=(state_spec, (repl, repl, repl, repl)),
-        check_vma=False)
+        out_specs=(state_spec, (repl, repl, repl, repl)))
     return jax.jit(mapped, donate_argnums=(0,))

@@ -205,6 +205,10 @@ class Runtime:
         self._next_gc = self.opts.gc_initial   # ≙ heap.c next_gc
         self._host_errors: Dict[int, int] = {}
         self._host_error_locs: Dict[int, str] = {}
+        self.tuning_record: Optional[Dict[str, Any]] = None   # set by
+        #   start() when any option is "auto" (tuning.resolve): source
+        #   (cache/calibrated/default), per-variant tick_ms table,
+        #   winner — bench.py publishes it as the A/B record
 
     # Any state assignment — including a driver pushing rt._step results
     # back, as bench.py does — conservatively invalidates the cached
@@ -252,6 +256,12 @@ class Runtime:
                 raise ValueError(
                     f"cannot pin host thread to core {self.opts.pin}: "
                     f"{e}") from None
+        # Persistent compile cache (tuning.enable_compile_cache): lands
+        # before the first jit of this runtime so warm starts reload
+        # executables instead of re-lowering (PROFILE.md §4b's 11.8 s).
+        from .. import tuning
+        from ..config import auto_fields
+        tuning.enable_compile_cache(self.opts.compile_cache)
         self.program.finalize()
         self.state = init_state(self.program, self.opts)
         if self.program.shards > 1:
@@ -260,6 +270,15 @@ class Runtime:
             self.state = shard_state(self.state, self.mesh)
         else:
             self.mesh = None
+        if auto_fields(self.opts):
+            # Resolve "auto" formulation choices to measured winners
+            # BEFORE the engine traces (it only ever sees concrete
+            # opts). Calibration runs on throwaway copies of the fresh
+            # state; only delivery/pallas/pallas_fused may change, none
+            # of which affect Program layout or state shapes.
+            self.opts, self.tuning_record = tuning.resolve(
+                self.program, self.opts, self.mesh, self.state)
+            self.program.opts = self.opts
         self._step = engine.jit_step(self.program, self.opts, self.mesh)
         self._multi = engine.jit_multi_step(self.program, self.opts,
                                             self.mesh)
@@ -552,6 +571,22 @@ class Runtime:
                 self._check_ids_in_cohort(
                     v, want, f"field {atype.__name__}.{fname}")
 
+    def _check_host_iso_blob(self, h: int) -> None:
+        """An iso Blob handle leaving the host must be host-OWNED
+        (present in _host_blobs): blob_store() mints ownership, host
+        delivery of an iso Blob arg transfers it. Anything else —
+        double-send, a stale handle, a forged int — is an aliased move,
+        rejected loudly like HostHeap.send_iso and the device trace's
+        use-after-move (null/-1 rides freely)."""
+        if h >= 0 and h not in self._host_blobs:
+            from ..hostmem import CapabilityError
+            raise CapabilityError(
+                f"capability: aliased move — iso blob handle {h} is not "
+                "owned by the host (already sent, freed, or never "
+                "obtained via blob_store/host delivery); an iso is "
+                "moved-unique — use a BlobVal parameter for shared "
+                "payloads")
+
     # ---- external sends (≙ pony_sendv from outside the runtime) ----
     def send(self, target: int, behaviour_def: BehaviourDef, *args):
         if behaviour_def.global_id is None:
@@ -578,14 +613,21 @@ class Runtime:
                 if (pack.cap_mode(spec) == "iso"
                         and not pack.is_blob(spec) and int(a) > 0):
                     heap.send_iso(int(a))
-        if self._host_blobs:
+        if self.opts.blob_slots > 0:
             # A sent ISO blob handle is MOVED off the host: it stops
             # being a GC root here (the in-flight message keeps it
             # alive until the receiver owns it — gc.py's marks). A VAL
             # (shared) handle ALIASES: the host keeps its root until
             # rt.blob_release(h), so it can keep sending/fetching it.
+            # Moving a handle the host does NOT own (double-send, stale
+            # or forged int) is an aliased move — loud, matching
+            # HostHeap.send_iso and the device path's use-after-move
+            # (every legitimately host-sendable iso blob is in
+            # _host_blobs: blob_store() puts it there, and host
+            # delivery of an iso Blob arg transfers it there).
             for spec, a in zip(behaviour_def.arg_specs, args):
                 if pack.is_blob(spec) and not pack.is_blob_val(spec):
+                    self._check_host_iso_blob(int(a))
                     self._host_blobs.discard(int(a))
         # Host senders (the API and host behaviours both run here) to
         # host targets take the fast lane; everything else rides the
@@ -618,8 +660,14 @@ class Runtime:
         # ISO blob columns MOVE off the host exactly like send() args
         # (the handles stop being GC roots; in-flight mailbox words keep
         # the blobs alive until the receivers own them); VAL columns
-        # alias — the host keeps its roots until rt.blob_release.
-        if self._host_blobs:
+        # alias — the host keeps its roots until rt.blob_release. Same
+        # ownership check as send(): moving a handle the host does not
+        # own raises before any column is consumed.
+        if self.opts.blob_slots > 0:
+            for spec, col in zip(behaviour_def.arg_specs, arg_cols):
+                if pack.is_blob(spec) and not pack.is_blob_val(spec):
+                    for a in np.asarray(col).reshape(-1):
+                        self._check_host_iso_blob(int(a))
             for spec, col in zip(behaviour_def.arg_specs, arg_cols):
                 if pack.is_blob(spec) and not pack.is_blob_val(spec):
                     for a in np.asarray(col).reshape(-1):
@@ -698,7 +746,13 @@ class Runtime:
             t, w = self._inject_q.popleft()
             q = quota.get(t)
             if q is None:
-                q = quota[t] = self.program.cohort_of(t).batch
+                # Out-of-world targets have no cohort: any batch quota
+                # works — the device path drops them (sends stay
+                # permissive out of range; they dead-letter, as
+                # _check_send_target documents).
+                q = quota[t] = (self.program.cohort_of(t).batch
+                                if 0 <= t < self.program.total
+                                else self.opts.batch)
             c = taken.get(t, 0)
             if c >= q:
                 held.append((t, w))
@@ -854,6 +908,14 @@ class Runtime:
             for spec, a in zip(bdef.arg_specs, args):
                 if pack.cap_mode(spec) == "iso" and int(a) > 0:
                     heap.receive(int(a))
+        if self.opts.blob_slots > 0:
+            # An iso Blob delivered to a HOST actor completes its move
+            # HERE: the host now owns the handle (GC root; legitimately
+            # re-sendable — _check_host_iso_blob accepts it).
+            for spec, a in zip(bdef.arg_specs, args):
+                if (pack.is_blob(spec) and not pack.is_blob_val(spec)
+                        and int(a) >= 0):
+                    self._host_blobs.add(int(a))
         try:
             st2 = bdef.fn(ctx, st, *args)
         except PonyError as e:
@@ -980,7 +1042,16 @@ class Runtime:
             if bool(a.blob_fail):
                 raise BlobCapacityError(
                     f"device blob_alloc found no free pool slot by step "
-                    f"{self.steps_run}")
+                    f"{self.steps_run} — the pool is exhausted: raise "
+                    "RuntimeOptions.blob_slots, or free blobs "
+                    "(ctx.blob_free) faster")
+            if bool(a.blob_budget_fail):
+                raise BlobCapacityError(
+                    f"device blob_alloc exceeded its per-tick reservation "
+                    f"budget by step {self.steps_run} — more allocating "
+                    "dispatches than BLOB_DISPATCHES in one tick (free "
+                    "pool slots may remain): raise the actor class's "
+                    "BLOB_DISPATCHES (or lower its batch)")
             if bool(a.exit_flag):
                 self._exit_code = int(a.exit_code)
                 break

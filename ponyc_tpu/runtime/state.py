@@ -197,7 +197,15 @@ class RtState:
     #   BLOB_GEN_SHIFT): a stale handle to a recycled slot mismatches
     #   and reads null — ABA protection for the iso discipline's
     #   dynamic escape hatches (forged ints, post-sweep stragglers)
-    blob_fail: jnp.ndarray    # [P] bool — sticky: an alloc found no slot
+    blob_fail: jnp.ndarray    # [P] bool — sticky: an alloc found the
+    #   POOL exhausted (no free slot in the compacted free list —
+    #   raise RuntimeOptions.blob_slots or free faster)
+    blob_budget_fail: jnp.ndarray  # [P] bool — sticky: an alloc fell
+    #   outside the actor's per-tick reservation BUDGET (more
+    #   allocating dispatches than BLOB_DISPATCHES, with free slots
+    #   possibly plentiful — raise the class's BLOB_DISPATCHES). Kept
+    #   separate from blob_fail so the host error names the right knob
+    #   (≙ SpawnCapacityError naming its own)
     n_blob_alloc: jnp.ndarray   # [P] int32 — lifetime allocs
     n_blob_free: jnp.ndarray    # [P] int32 — lifetime frees
     n_blob_remote: jnp.ndarray  # [P] int32 — Blob args that arrived
@@ -297,6 +305,7 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         blob_len=jnp.zeros((p * opts.blob_slots,), i32),
         blob_gen=jnp.zeros((p * opts.blob_slots,), i32),
         blob_fail=jnp.zeros((p,), jnp.bool_),
+        blob_budget_fail=jnp.zeros((p,), jnp.bool_),
         n_blob_alloc=jnp.zeros((p,), i32),
         n_blob_free=jnp.zeros((p,), i32),
         n_blob_remote=jnp.zeros((p,), i32),

@@ -1,0 +1,481 @@
+"""Delivery/dispatch autotuner: measured machinery replacing the manual
+A/B campaign (PROFILE.md §4c–4e → §6).
+
+The engine has formulation choices with no shape- or hardware-independent
+winner: delivery as a cached stable-sort plan + permutation gathers
+("plan") vs one multi-operand co-sort ("cosort"); the mailbox drain as an
+XLA select-chain vs a Pallas kernel (`pallas`); dispatch as planar XLA vs
+the fused Pallas kernel (`pallas_fused`). CAF's OpenCL actor backend
+reached the same conclusion for behaviour offload (Wahlster et al.,
+arXiv:1709.07781 — the runtime must pick the execution configuration
+per workload), as did Halide's schedule search (arXiv:2105.12858): the
+choice is a measurement, not a design constant.
+
+So ``RuntimeOptions(delivery="auto")`` (and ``pallas="auto"`` /
+``pallas_fused="auto"``) defers the choice to ``Runtime.start()``:
+
+1. enumerate the eligible concrete variants (`variants`);
+2. time each on a synthetic busy workload built from the program's REAL
+   cohort shapes (`make_workload`) with a `lax.fori_loop` window over
+   the real step (`engine.build_forced_window`) — in-executable ticks
+   divided by trip count, the only methodology PROFILE.md §4b trusts
+   (per-call timings carry an ~11 ms launch floor through the tunnel);
+3. pick the minimum (`decide`) and record the full table;
+4. persist the decision in an on-disk cache keyed by (platform, jax
+   version, cohort layout, geometry) so steady-state starts skip
+   calibration entirely (`load_cached`/`store_cached`).
+
+Semantics are untouched by construction: calibration runs on throwaway
+copies of the state, and the only thing "auto" changes is which already-
+equivalence-tested formulation executes (tests/test_differential.py and
+tests/test_delivery_modes.py are the oracle that they agree).
+
+The synthetic workload seeds every device mailbox full of the cohort's
+first behaviour and parks a full receiver-spill aimed at one victim
+actor, so both the dispatch path (planar evaluation of every behaviour)
+and the delivery path (full-width sort + rebuild, with real accepted
+messages every tick) stay busy for the whole window. The measured regime
+re-sorts every tick (spill contents shift), i.e. it prices "plan" at its
+cache-MISS cost — conservative for plan, exact for cosort; the recorded
+table says so.
+
+Also here: `enable_compile_cache` wires jax's persistent compilation
+cache (the 11.8 s measured warmup, PROFILE.md §4b) for Runtime/bench.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import statistics
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .config import RuntimeOptions, auto_fields
+
+# Option fields a variant may override — the tuner must never touch a
+# field that changes Program layout or state shapes (the calibration
+# template and the runtime's real jitted step share both).
+VARIANT_FIELDS = ("delivery", "pallas", "pallas_fused")
+
+
+# ---------------------------------------------------------------------------
+# cache locations
+
+
+def _cache_dir(setting: str, env: str, leaf: str) -> Optional[str]:
+    """Resolve a cache-dir option ("auto"/"off"/path) against its env
+    override. Returns None when disabled."""
+    if setting == "off":
+        return None
+    if setting in ("", "auto"):
+        setting = os.environ.get(env, "")
+        if setting.lower() in ("off", "0"):
+            return None
+        if not setting:
+            setting = os.path.join(os.path.expanduser("~"), ".cache",
+                                   "ponyc_tpu", leaf)
+    return setting
+
+
+def tuning_cache_dir(opts: RuntimeOptions) -> Optional[str]:
+    return _cache_dir(opts.tuning_cache, "PONY_TPU_TUNING_CACHE", "tuning")
+
+
+def compile_cache_dir(opts: RuntimeOptions) -> Optional[str]:
+    return _cache_dir(opts.compile_cache, "PONY_TPU_COMPILE_CACHE", "xla")
+
+
+_compile_cache_on: Optional[str] = None
+
+
+def enable_compile_cache(setting: str = "auto") -> Optional[str]:
+    """Point jax's persistent compilation cache at a directory (default
+    ~/.cache/ponyc_tpu/xla, $PONY_TPU_COMPILE_CACHE overrides, "off"
+    disables). Returns the directory in use, or None. Idempotent;
+    best-effort — an older jax without the knobs leaves config
+    untouched rather than failing the start.
+
+    CPU guard: on the CPU backend this jaxlib's cache round-trip is
+    UNSOUND for the engine's donated while-loop executables — reloaded
+    executables corrupt runtime state (observed on jaxlib 0.4.37:
+    tests/test_host_api_fuzz.py invariant violations and fatal aborts
+    the moment a cached step/gc executable is reused, at default cache
+    thresholds too). The warmup this cache attacks (11.8 s, PROFILE.md
+    §4b) lives on the accelerator anyway, so CPU keeps the cache off
+    unless PONY_TPU_COMPILE_CACHE_FORCE=1 (for re-testing the bug on
+    newer jaxlibs)."""
+    global _compile_cache_on
+    path = _cache_dir(setting, "PONY_TPU_COMPILE_CACHE", "xla")
+    if path is None:
+        return None
+    import jax
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:                 # noqa: BLE001 — no backend at all
+        return None
+    if platform == "cpu" and os.environ.get(
+            "PONY_TPU_COMPILE_CACHE_FORCE", "0") != "1":
+        return None
+    if _compile_cache_on == path:
+        return path
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Warmup is THE metric here (11.8 s measured, PROFILE.md §4b):
+        # cache every executable, not just slow-to-compile ones.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, ValueError, OSError):
+        return None
+    _compile_cache_on = path
+    return path
+
+
+# ---------------------------------------------------------------------------
+# variant enumeration
+
+
+def pallas_cohort_ok(rows: int) -> bool:
+    """The drain/fused kernels' block-alignment precondition
+    (ops.mailbox_kernel / ops.fused_dispatch LANE_BLOCK)."""
+    from .ops import mailbox_kernel as mk
+    return rows <= mk.LANE_BLOCK or rows % mk.LANE_BLOCK == 0
+
+
+def pallas_eligible(program) -> bool:
+    """Some device cohort would actually route its drain through the
+    Pallas kernel (engine falls back silently otherwise — a variant
+    that falls back everywhere is the baseline wearing a costume)."""
+    return any(ch.behaviours and pallas_cohort_ok(ch.local_capacity)
+               for ch in program.device_cohorts)
+
+
+def fused_eligible(program, opts: RuntimeOptions) -> bool:
+    """Some device cohort satisfies the fused kernel's structural
+    preconditions (ops.fused_dispatch.eligible: behaviours present, no
+    blob pool, block-aligned rows, no synchronous construction —
+    discovered via the verify pass's probe tracing, the same facts the
+    engine's own probe finds)."""
+    from . import verify
+    for ch in program.device_cohorts:
+        if not ch.behaviours:
+            continue
+        if opts.blob_slots > 0 and ch.uses_blobs:
+            continue
+        if not pallas_cohort_ok(ch.local_capacity):
+            continue
+        if any(verify.behaviour_effects(
+                b, ch.atype, msg_words=opts.msg_words,
+                default_max_sends=opts.max_sends).sync_spawns
+               for b in ch.behaviours):
+            continue
+        return True
+    return False
+
+
+def variants(program, opts: RuntimeOptions) -> List[Tuple[str, Dict]]:
+    """Ordered (name, overrides) candidates for the opts' "auto" fields.
+    The first entry is the baseline (plan / kernels off); `decide`
+    breaks ties toward earlier entries, so noise can never flip a dead
+    heat away from the safe default."""
+    deliveries = (["plan", "cosort"] if opts.delivery == "auto"
+                  else [opts.delivery])
+    pallas_vals = ([False, True]
+                   if opts.pallas == "auto" and pallas_eligible(program)
+                   else [False if opts.pallas == "auto" else opts.pallas])
+    fused_vals = ([False, True]
+                  if (opts.pallas_fused == "auto"
+                      and fused_eligible(program, opts))
+                  else [False if opts.pallas_fused == "auto"
+                        else opts.pallas_fused])
+    out: List[Tuple[str, Dict]] = []
+    for f in fused_vals:
+        for p in pallas_vals:
+            for d in deliveries:
+                name = d + ("+pallas" if p else "") + ("+fused" if f else "")
+                out.append((name, {"delivery": d, "pallas": p,
+                                   "pallas_fused": f}))
+    return out
+
+
+def decide(table: Dict[str, Optional[float]],
+           order: Optional[List[str]] = None) -> Optional[str]:
+    """The winning variant: minimum tick_ms, exact ties broken toward
+    the earlier entry in `order` (insertion order by default — the
+    baseline). Entries with None (variant failed to build/run) never
+    win. Deterministic given the table — the property the tests pin."""
+    order = list(table.keys()) if order is None else order
+    best = None
+    for name in order:
+        t = table.get(name)
+        if t is None:
+            continue
+        if best is None or t < table[best]:
+            best = name
+    return best
+
+
+# ---------------------------------------------------------------------------
+# the decision-table key
+
+
+def tuning_key(program, opts: RuntimeOptions) -> Dict[str, Any]:
+    """Everything the decision legitimately depends on — backend,
+    compiler version, cohort layout, geometry — and nothing it doesn't
+    (actor field VALUES don't change op shapes). Same key ⇒ the cached
+    winner transfers."""
+    import jax
+    dev = jax.devices()[0]
+    cohorts = [
+        {"type": ch.atype.__name__, "capacity": int(ch.capacity),
+         "batch": int(ch.batch), "max_sends": int(ch.max_sends),
+         "msg_words": int(ch.msg_words),
+         "behaviours": len(ch.behaviours),
+         "host": bool(ch.host), "blobs": bool(ch.uses_blobs)}
+        for ch in program.cohorts]
+    geometry = {f: getattr(opts, f) for f in (
+        "mailbox_cap", "msg_words", "batch", "max_sends", "spill_cap",
+        "inject_slots", "mesh_shards", "route_bucket", "mute_slots",
+        "dispatch_gating", "blob_slots", "blob_words")}
+    return {
+        "v": 1,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "jax": jax.__version__,
+        "auto": sorted(auto_fields(opts)),
+        "fixed": {f: getattr(opts, f) for f in VARIANT_FIELDS
+                  if getattr(opts, f) != "auto"},
+        "geometry": geometry,
+        "cohorts": cohorts,
+    }
+
+
+def cache_path(cache_dir: str, key: Dict[str, Any]) -> str:
+    blob = json.dumps(key, sort_keys=True).encode()
+    return os.path.join(cache_dir,
+                        hashlib.sha256(blob).hexdigest()[:24] + ".json")
+
+
+def load_cached(cache_dir: Optional[str],
+                key: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The cached record for `key`, or None on miss/corruption (a
+    corrupt file recalibrates — and is then overwritten — rather than
+    erroring a start)."""
+    if cache_dir is None:
+        return None
+    path = cache_path(cache_dir, key)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or rec.get("key") != key \
+            or not isinstance(rec.get("chosen"), dict):
+        return None
+    return rec
+
+
+def store_cached(cache_dir: Optional[str], key: Dict[str, Any],
+                 record: Dict[str, Any]) -> Optional[str]:
+    """Best-effort persist (atomic rename; an unwritable cache dir never
+    fails the start). Returns the path written, or None."""
+    if cache_dir is None:
+        return None
+    path = cache_path(cache_dir, key)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the synthetic calibration workload
+
+
+def make_workload(program, opts: RuntimeOptions, state):
+    """A throwaway busy state on the program's REAL cohort shapes.
+
+    Built from the fresh post-start() state (all-zero mailboxes) by
+    sharding-preserving array ops:
+
+    - every device-cohort actor is alive with a FULL mailbox of its
+      cohort's first behaviour (zero args) — the dispatch path runs its
+      full planar cost while those drain (`ceil(cap/batch)` ticks), and
+      the outbox keeps delivery's sort at full static width every tick;
+    - the receiver spill is parked full, aimed at one victim actor
+      (the first device cohort's row 0) — each tick the victim drains
+      `batch` and delivery re-accepts `batch` spill entries, so REAL
+      accepted messages flow through the sort/rebuild/pressure paths
+      for ~spill_cap/batch sustained ticks, far past any window length
+      the tuner uses.
+
+    Values are garbage by design; the state is never installed — "auto"
+    may change speed only, never semantics.
+    """
+    import jax.numpy as jnp
+
+    cap = opts.mailbox_cap
+    p = program.shards
+    nl = program.n_local
+    victim = None
+    mask_local = np.zeros((nl,), bool)
+    for ch in program.device_cohorts:
+        mask_local[ch.local_start:ch.local_stop] = True
+        if victim is None and ch.behaviours:
+            victim = ch
+    if not mask_local.any():
+        return None, 0
+    mask = jnp.asarray(np.tile(mask_local, p))
+
+    new_buf = dict(state.buf)
+    for ch in program.device_cohorts:
+        gid0 = ch.behaviours[0].global_id if ch.behaviours else -7
+        new_buf[ch.atype.__name__] = \
+            state.buf[ch.atype.__name__].at[:, 0, :].set(jnp.int32(gid0))
+
+    kw = dict(
+        buf=new_buf,
+        alive=state.alive | mask,
+        tail=jnp.where(mask, jnp.int32(cap), state.tail),
+    )
+    sustain = max(1, cap // max(1, opts.batch))
+    if victim is not None:
+        vgid = victim.behaviours[0].global_id
+        kw.update(
+            dspill_tgt=state.dspill_tgt * 0 + jnp.int32(victim.local_start),
+            dspill_sender=state.dspill_sender * 0 - 1,
+            dspill_words=state.dspill_words.at[0, :].set(jnp.int32(vgid)),
+            dspill_count=state.dspill_count * 0 + jnp.int32(opts.spill_cap),
+        )
+        sustain = max(sustain, opts.spill_cap // max(1, victim.batch))
+    return dataclasses.replace(state, **kw), sustain
+
+
+# ---------------------------------------------------------------------------
+# calibration + resolution
+
+
+def _window_ticks(opts: RuntimeOptions, sustain: int) -> int:
+    if opts.tuning_ticks > 0:
+        return opts.tuning_ticks
+    return max(2, min(16, sustain))
+
+
+def calibrate(program, opts: RuntimeOptions, mesh, state,
+              names_overrides: List[Tuple[str, Dict]],
+              ) -> Tuple[Dict[str, Optional[float]], Dict[str, Any]]:
+    """Time every candidate on the synthetic workload. Returns
+    ({name: tick_ms or None}, detail) — a variant that fails to
+    build/run records None and the error string instead of failing the
+    start (e.g. an unmeasured Mosaic lowering on a new backend)."""
+    import jax
+    import jax.numpy as jnp
+    from .runtime import engine
+
+    template, sustain = make_workload(program, opts, state)
+    detail: Dict[str, Any] = {"errors": {}}
+    table: Dict[str, Optional[float]] = {}
+    if template is None:          # host-only program: nothing to measure
+        for name, _ov in names_overrides:
+            table[name] = None
+        detail["skipped"] = "no device cohorts"
+        return table, detail
+
+    k = _window_ticks(opts, sustain)
+    repeats = opts.tuning_repeats
+    w1 = 1 + opts.msg_words
+    slots = opts.inject_slots
+    empty_inject = (jnp.full((slots,), -1, jnp.int32),
+                    jnp.zeros((w1, slots), jnp.int32))
+    limit = jnp.int32(k)
+    detail.update(ticks_per_window=k, repeats=repeats,
+                  sustain_ticks=int(sustain))
+
+    for name, overrides in names_overrides:
+        vopts = dataclasses.replace(opts, **overrides)
+        try:
+            fn = engine.jit_forced_window(program, vopts, mesh)
+            t0 = time.perf_counter()
+            out = fn(jax.tree.map(jnp.copy, template), *empty_inject,
+                     limit)
+            jax.block_until_ready(out)
+            compile_s = time.perf_counter() - t0
+            times = []
+            for _ in range(repeats):
+                st_in = jax.tree.map(jnp.copy, template)
+                jax.block_until_ready(st_in)
+                t0 = time.perf_counter()
+                out = fn(st_in, *empty_inject, limit)
+                jax.block_until_ready(out)
+                times.append(time.perf_counter() - t0)
+            table[name] = 1e3 * statistics.median(times) / k
+            detail.setdefault("compile_s", {})[name] = round(compile_s, 3)
+        except Exception as e:            # noqa: BLE001 — variant, not start
+            table[name] = None
+            detail["errors"][name] = f"{type(e).__name__}: {e}"[:500]
+    return table, detail
+
+
+def resolve(program, opts: RuntimeOptions, mesh, state,
+            ) -> Tuple[RuntimeOptions, Dict[str, Any]]:
+    """Turn "auto" option values into concrete ones: cache hit →
+    cached winner; miss → calibrate, decide, persist. Returns
+    (concrete opts, decision record). The record rides into bench.py's
+    JSON so every bench doubles as the A/B campaign's lab notebook."""
+    autos = auto_fields(opts)
+    if not autos:
+        return opts, {"source": "none", "chosen": {}, "table": {}}
+
+    cands = variants(program, opts)
+    baseline = cands[0]
+    record: Dict[str, Any] = {
+        "auto": autos,
+        "variants": [n for n, _ in cands],
+        "table": {},
+        "detail": {},
+    }
+
+    if len(cands) == 1:
+        # Nothing eligible beyond the baseline (e.g. pallas_fused="auto"
+        # on an all-ineligible program): decide without measuring.
+        name, overrides = baseline
+        record.update(source="default", chosen=overrides, winner=name)
+        return dataclasses.replace(opts, **overrides), record
+
+    key = tuning_key(program, opts)
+    cdir = tuning_cache_dir(opts)
+    record["cache_dir"] = cdir
+    cached = load_cached(cdir, key)
+    if cached is not None:
+        record.update(source="cache", chosen=cached["chosen"],
+                      winner=cached.get("winner"),
+                      table=cached.get("table", {}),
+                      cache_path=cache_path(cdir, key))
+        return dataclasses.replace(opts, **cached["chosen"]), record
+
+    table, detail = calibrate(program, opts, mesh, state, cands)
+    winner = decide(table, order=[n for n, _ in cands])
+    if winner is None:
+        winner = baseline[0]
+    overrides = dict(cands)[winner]
+    record.update(source="calibrated", chosen=overrides, winner=winner,
+                  table={n: (None if t is None else round(t, 4))
+                         for n, t in table.items()},
+                  detail=detail)
+    stored = store_cached(cdir, key, {
+        "key": key, "chosen": overrides, "winner": winner,
+        "table": record["table"], "detail": detail,
+        "written_unix": time.time()})
+    if stored:
+        record["cache_path"] = stored
+    return dataclasses.replace(opts, **overrides), record

@@ -73,6 +73,21 @@ def test_deadletter_counted():
     assert rt.counter("n_deadletter") == 1
 
 
+def test_out_of_world_send_drops_and_quiesces():
+    # Sends stay permissive past the world's edge (_check_send_target):
+    # the message must DROP on device and the program must still
+    # quiesce — the inject path once crashed looking up the cohort of
+    # an id no cohort owns.
+    rt = Runtime(OPTS)
+    rt.declare(A, 2)
+    rt.start()
+    a = rt.spawn(A)
+    rt.send(10_000_000, A.bump, 1)       # far out of [0, total)
+    rt.send(a, A.bump, 1)                # a real message rides along
+    assert rt.run(max_steps=20) == 0
+    assert rt.state_of(a)["count"] == 1
+
+
 def test_strip_runtime_flags():
     opts, rest = strip_runtime_flags(
         ["prog", "--pony_mailbox_cap", "128", "--ponybatch=16",

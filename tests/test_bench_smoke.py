@@ -1,0 +1,69 @@
+"""Fast bench-wiring smoke test: the fused measurement window driven
+through delivery="auto" at toy scale, so bench.py's harness (counter
+verification + the tuning record every run publishes) can never silently
+rot between the rare on-chip campaigns (the round-3→5 lesson: the A/B
+machinery sat unmeasured for three rounds because nothing cheap
+exercised it)."""
+
+import argparse
+
+import pytest
+
+
+def _args(**kw):
+    base = dict(actors=64, ticks=8, fuse=4, warmup=1, cap=4, pings=2,
+                delivery="auto", fused="off", pallas="off",
+                lat_actors=64, lat_ticks=40)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+@pytest.fixture()
+def bench_mod(tmp_path, monkeypatch):
+    monkeypatch.setenv("PONY_TPU_TUNING_CACHE", str(tmp_path / "tuning"))
+    monkeypatch.setenv("PONY_TPU_COMPILE_CACHE", "off")
+    import bench
+    return bench
+
+
+def test_bench_ubench_auto_smoke(bench_mod):
+    ub = bench_mod.bench_ubench(_args())
+    # The fused window really advanced the world: every tick dispatched
+    # actors×pings behaviours (the headline metric's denominator).
+    assert ub["processed_counter_ok"]
+    assert ub["msgs_per_sec"] > 0
+    assert ub["ticks"] == 8 and ub["fuse"] == 4
+    # auto resolved to a concrete formulation...
+    assert ub["delivery"] in ("plan", "cosort")
+    # ...and published a well-formed tuning record: every eligible
+    # variant measured in-executable, the minimum selected.
+    rec = ub["tuning"]
+    assert rec["source"] in ("calibrated", "cache")
+    assert set(rec["table"]) == {"plan", "cosort"}
+    timed = {k: v for k, v in rec["table"].items() if v is not None}
+    assert timed, "no variant produced a timing"
+    assert all(v > 0 for v in timed.values())
+    assert rec["winner"] in timed
+    assert rec["table"][rec["winner"]] == min(timed.values())
+    assert rec["chosen"]["delivery"] == ub["delivery"]
+
+
+def test_bench_forced_delivery_skips_tuning(bench_mod):
+    ub = bench_mod.bench_ubench(_args(delivery="plan"))
+    assert ub["processed_counter_ok"]
+    assert ub["delivery"] == "plan"
+    assert ub["tuning"] is None          # nothing was "auto"
+
+
+def test_bench_latency_uses_resolved_formulation(bench_mod):
+    lat = bench_mod.bench_latency(_args(), delivery="cosort", fused=False)
+    assert lat["hops_ok"]
+    assert lat["p50_us"] > 0
+
+
+def test_tristate_parsing(bench_mod):
+    assert bench_mod.tristate("auto") == "auto"
+    assert bench_mod.tristate("on") is True
+    assert bench_mod.tristate("1") is True
+    assert bench_mod.tristate("off") is False
+    assert bench_mod.tristate("0") is False

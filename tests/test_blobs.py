@@ -117,6 +117,70 @@ def test_pool_exhaustion_raises():
         rt.run(max_steps=10)
 
 
+def test_pool_exhaustion_message_names_blob_slots():
+    # The POOL-exhaustion error must point at blob_slots, never at
+    # BLOB_DISPATCHES (they were conflated under one sticky flag once).
+    @actor
+    class Leaker(Actor):
+        n: I32
+        MAX_BLOBS = 1
+
+        @behaviour
+        def leak(self, st):
+            self.blob_alloc()
+            return st
+
+    rt = Runtime(RuntimeOptions(**{**OPTS, "blob_slots": 2}))
+    rt.declare(Leaker, 4).start()
+    a = rt.spawn(Leaker, n=0)
+    for _ in range(3):
+        rt.send(a, Leaker.leak)
+    with pytest.raises(BlobCapacityError, match="blob_slots"):
+        rt.run(max_steps=10)
+
+
+def test_budget_exhaustion_names_blob_dispatches():
+    # BLOB_DISPATCHES exhaustion with a half-empty pool must blame the
+    # BUDGET knob: 2 allocating dispatches in one tick against
+    # BLOB_DISPATCHES=1, 16 free slots.
+    @actor
+    class Hungry(Actor):
+        n: I32
+        MAX_BLOBS = 1
+        BLOB_DISPATCHES = 1
+
+        @behaviour
+        def grab(self, st):
+            self.blob_alloc(length=1)
+            return st
+
+    rt = Runtime(RuntimeOptions(**OPTS))       # batch=2: both msgs in
+    rt.declare(Hungry, 4).start()              # one tick's drain
+    a = rt.spawn(Hungry, n=0)
+    rt.send(a, Hungry.grab)
+    rt.send(a, Hungry.grab)
+    with pytest.raises(BlobCapacityError, match="BLOB_DISPATCHES"):
+        rt.run(max_steps=10)
+
+
+def test_host_iso_blob_double_send_raises():
+    # ADVICE round 5: the host moving an iso blob it does not own must
+    # be LOUD (matching HostHeap.send_iso and the device trace), not a
+    # silent null-read downstream.
+    from ponyc_tpu.hostmem import CapabilityError
+    rt = Runtime(RuntimeOptions(**OPTS))
+    rt.declare(Consumer, 2).start()
+    c = rt.spawn(Consumer, total=0, seen=0)
+    h = rt.blob_store([5])
+    rt.send(c, Consumer.take, h)               # legal move
+    with pytest.raises(CapabilityError, match="aliased move"):
+        rt.send(c, Consumer.take, h)           # double-send of an iso
+    with pytest.raises(CapabilityError, match="aliased move"):
+        rt.send(c, Consumer.take, 12345)       # never-owned forged int
+    rt.run(max_steps=6)
+    assert rt.state_of(c)["seen"] == 1
+
+
 def test_max_blobs_budget_rejects_at_build():
     @actor
     class Greedy(Actor):
@@ -307,8 +371,9 @@ def test_stale_handle_reads_zero_not_leftovers():
     rt.declare(Reader, 2).start()
     a = rt.spawn(Reader, got=0)
     h = rt.blob_store([777])
-    rt.blob_free_host(h)                # slot free again, words remain
-    rt.send(a, Reader.probe, h)         # forged read of the freed slot
+    rt.send(a, Reader.probe, h)         # legal move (host owns h here)
+    rt.blob_free_host(h)                # freed before dispatch: by the
+    #   time probe runs the slot is unallocated (words still there)
     rt.run(max_steps=6)
     assert rt.state_of(a)["got"] == 0   # used-gate: no leftover leak
 
@@ -330,12 +395,13 @@ def test_recycled_slot_stale_handle_reads_zero():
     rt.declare(Reader, 2).start()
     a = rt.spawn(Reader, got=0)
     h_old = rt.blob_store([111])
-    rt.blob_free_host(h_old)
+    rt.send(a, Reader.probe, h_old)     # legal move (host owns h_old)
+    rt.blob_free_host(h_old)            # ...then freed before dispatch
     h_new = rt.blob_store([222])        # 1-slot pool: SAME slot, new gen
     from ponyc_tpu.ops import pack
     assert pack.blob_slot(h_old) == pack.blob_slot(h_new)
-    assert h_old != h_new               # generations differ
-    rt.send(a, Reader.probe, h_old)     # stale handle
+    assert h_old != h_new               # generations differ: the
+    #   in-flight message now carries a stale handle
     rt.run(max_steps=6)
     assert rt.state_of(a)["got"] == 0   # gen mismatch → null read
     with pytest.raises(KeyError, match="STALE"):

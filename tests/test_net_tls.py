@@ -9,6 +9,11 @@ import os
 
 import pytest
 
+# The self-signed test certificates come from the optional `cryptography`
+# package (README: optional extras). The TLS layer itself is stdlib-ssl
+# only; without the cert generator these tests skip rather than error.
+pytest.importorskip("cryptography")
+
 from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
 from ponyc_tpu.net.tls import TLSClientConfig, TLSServerConfig
 

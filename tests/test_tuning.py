@@ -1,0 +1,239 @@
+"""The delivery/dispatch autotuner (ponyc_tpu/tuning.py).
+
+Three properties are pinned:
+
+- "auto" never changes semantics, only speed: a seeded ubench run under
+  delivery="auto" produces exactly the totals and per-actor columns of
+  the forced formulations (which the differential suite already proves
+  agree with the sequential oracle);
+- the decision is a deterministic pure function of the timing table
+  (minimum tick_ms, ties to the earlier/safer variant, failed variants
+  never win);
+- the on-disk tuning cache hits on an identical (platform, layout,
+  geometry) key, misses on a different one, and a corrupt cache file
+  recalibrates instead of erroring the start.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import Runtime, RuntimeOptions, actor, behaviour, I32
+from ponyc_tpu import tuning
+from ponyc_tpu.models import ubench
+
+
+def _ub_opts(**kw):
+    base = dict(mailbox_cap=4, batch=4, max_sends=1, msg_words=1,
+                spill_cap=64, inject_slots=8, compile_cache="off",
+                tuning_cache="off", tuning_ticks=2, tuning_repeats=1)
+    base.update(kw)
+    return RuntimeOptions(**base)
+
+
+def _run_ubench(delivery, n=64, pings=2, ticks=5, **kw):
+    rt, ids = ubench.build(n, _ub_opts(delivery=delivery, **kw),
+                           pings=pings)
+    ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)
+    st, inj = rt.state, rt._empty_inject
+    for _ in range(ticks):
+        st, _aux = rt._step(st, *inj)
+    rt.state = st
+    cols = rt.cohort_state(ubench.Pinger)
+    return rt, {"processed": rt.counter("n_processed"),
+                "delivered": rt.counter("n_delivered"),
+                "pings": np.asarray(cols["pings"])}
+
+
+# ---------------------------------------------------------------------------
+# decision function
+
+
+def test_decide_picks_minimum():
+    assert tuning.decide({"plan": 2.0, "cosort": 1.0}) == "cosort"
+    assert tuning.decide({"plan": 0.5, "cosort": 1.0}) == "plan"
+
+
+def test_decide_breaks_ties_toward_baseline():
+    # Equal timings: the EARLIER entry (the safe baseline) wins, so
+    # measurement noise can never flip a dead heat to the exotic path.
+    assert tuning.decide({"plan": 1.0, "cosort": 1.0}) == "plan"
+    assert tuning.decide({"plan": 1.0, "plan+fused": 1.0,
+                          "cosort": 1.0}) == "plan"
+
+
+def test_decide_never_picks_failed_variants():
+    assert tuning.decide({"plan": 3.0, "cosort": None}) == "plan"
+    assert tuning.decide({"plan": None, "cosort": 2.0}) == "cosort"
+    assert tuning.decide({"plan": None, "cosort": None}) is None
+
+
+def test_decide_is_deterministic_given_injected_timings():
+    table = {"plan": 1.7, "cosort": 1.1, "plan+pallas": None,
+             "cosort+pallas": 1.1000001}
+    for _ in range(5):
+        assert tuning.decide(table) == "cosort"
+
+
+# ---------------------------------------------------------------------------
+# variant enumeration
+
+
+def test_variants_fixed_delivery_is_single():
+    rt = Runtime(_ub_opts(delivery="plan"))
+    rt.declare(ubench.Pinger, 8)
+    rt.program.finalize()
+    assert tuning.variants(rt.program, rt.opts) == [
+        ("plan", {"delivery": "plan", "pallas": False,
+                  "pallas_fused": False})]
+
+
+def test_variants_auto_delivery_baseline_first():
+    rt = Runtime(_ub_opts(delivery="auto"))
+    rt.declare(ubench.Pinger, 8)
+    rt.program.finalize()
+    names = [n for n, _ in tuning.variants(rt.program, rt.opts)]
+    assert names == ["plan", "cosort"]
+
+
+def test_variants_fused_auto_skips_ineligible_programs():
+    # A blob-pool cohort is ineligible for the fused kernel; with every
+    # cohort ineligible, pallas_fused="auto" must not enumerate (or
+    # silently measure) a variant that would fall back to the baseline.
+    @actor
+    class BlobUser:
+        n: I32
+        MAX_BLOBS = 1
+
+        @behaviour
+        def grab(self, st):
+            self.blob_alloc(length=1)
+            return st
+
+    rt = Runtime(_ub_opts(delivery="plan", pallas_fused="auto",
+                          msg_words=2, blob_slots=8, blob_words=4))
+    rt.declare(BlobUser, 8)
+    rt.program.finalize()
+    names = [n for n, _ in tuning.variants(rt.program, rt.opts)]
+    assert names == ["plan"]
+
+
+# ---------------------------------------------------------------------------
+# forced-variant equivalence (the "auto never changes semantics" oracle)
+
+
+def test_auto_matches_forced_variants():
+    _, plan = _run_ubench("plan")
+    _, cosort = _run_ubench("cosort")
+    _, auto = _run_ubench("auto")
+    assert plan["processed"] == cosort["processed"] == auto["processed"]
+    assert plan["delivered"] == cosort["delivered"] == auto["delivered"]
+    np.testing.assert_array_equal(plan["pings"], cosort["pings"])
+    np.testing.assert_array_equal(plan["pings"], auto["pings"])
+
+
+def test_auto_resolves_to_concrete_opts():
+    rt, _ = _run_ubench("auto")
+    assert rt.opts.delivery in ("plan", "cosort")
+    rec = rt.tuning_record
+    assert rec["source"] == "calibrated"           # cache is off here
+    assert set(rec["table"]) == {"plan", "cosort"}
+    assert all(isinstance(v, float) for v in rec["table"].values())
+    assert rec["winner"] == tuning.decide(rec["table"],
+                                          order=rec["variants"])
+    assert rec["chosen"]["delivery"] == rt.opts.delivery
+
+
+def test_calibration_leaves_runtime_state_untouched():
+    # Calibration runs on throwaway copies: a freshly started world must
+    # still be empty (no live actors, no queued messages, zero counters).
+    rt = Runtime(_ub_opts(delivery="auto"))
+    rt.declare(ubench.Pinger, 32)
+    rt.start()
+    assert rt.counter("n_processed") == 0
+    assert rt.counter("n_delivered") == 0
+    assert not bool(np.asarray(rt.state.alive).any())
+    assert int(np.asarray(rt.state.tail).sum()) == 0
+    assert int(np.asarray(rt.state.dspill_count).sum()) == 0
+
+
+# ---------------------------------------------------------------------------
+# tuning cache
+
+
+def test_cache_miss_then_hit_then_corrupt(tmp_path):
+    cdir = str(tmp_path / "tuning")
+
+    _, rec1 = tuning_record_for(cdir)
+    assert rec1["source"] == "calibrated"
+    path = rec1["cache_path"]
+    with open(path) as f:
+        stored = json.load(f)
+    assert stored["chosen"] == rec1["chosen"]
+
+    _, rec2 = tuning_record_for(cdir)
+    assert rec2["source"] == "cache"
+    assert rec2["chosen"] == rec1["chosen"]
+    assert rec2["table"] == rec1["table"]
+
+    with open(path, "w") as f:
+        f.write("{corrupt json!")
+    _, rec3 = tuning_record_for(cdir)
+    assert rec3["source"] == "calibrated"       # corruption recalibrates
+    with open(path) as f:
+        assert json.load(f)["chosen"] == rec3["chosen"]   # and rewrites
+
+
+def tuning_record_for(cdir):
+    rt, _ = _run_ubench("auto", tuning_cache=cdir)
+    return rt, rt.tuning_record
+
+
+def test_cache_key_separates_layouts(tmp_path):
+    cdir = str(tmp_path / "tuning")
+    rt1, _ = _run_ubench("auto", n=64, tuning_cache=cdir)
+    assert rt1.tuning_record["source"] == "calibrated"
+    rt2, _ = _run_ubench("auto", n=128, tuning_cache=cdir)
+    assert rt2.tuning_record["source"] == "calibrated"   # different key
+    rt3, _ = _run_ubench("auto", n=64, tuning_cache=cdir)
+    assert rt3.tuning_record["source"] == "cache"
+
+
+def test_cache_off_never_writes(tmp_path):
+    rt, _ = _run_ubench("auto", tuning_cache="off")
+    assert rt.tuning_record["source"] == "calibrated"
+    assert "cache_path" not in rt.tuning_record
+
+
+# ---------------------------------------------------------------------------
+# workload construction
+
+
+def test_workload_is_busy_on_real_shapes():
+    rt = Runtime(_ub_opts(delivery="plan"))
+    rt.declare(ubench.Pinger, 32)
+    rt.start()
+    wl, sustain = tuning.make_workload(rt.program, rt.opts, rt.state)
+    assert sustain >= 1
+    assert bool(np.asarray(wl.alive).any())
+    occ = np.asarray(wl.tail) - np.asarray(wl.head)
+    assert (occ[np.asarray(wl.alive)] == rt.opts.mailbox_cap).all()
+    assert int(np.asarray(wl.dspill_count).sum()) \
+        == rt.opts.spill_cap * rt.program.shards
+
+
+def test_host_only_program_skips_calibration():
+    @actor
+    class H:
+        HOST = True
+        n: I32
+
+        @behaviour
+        def tick(self, st):
+            return {**st, "n": st["n"] + 1}
+
+    rt = Runtime(_ub_opts(delivery="auto"))
+    rt.declare(H, 4)
+    rt.start()                      # must not raise, must resolve
+    assert rt.opts.delivery in ("plan", "cosort")
