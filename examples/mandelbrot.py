@@ -10,11 +10,16 @@ sys.path.insert(0, ".")
 from ponyc_tpu.models import mandelbrot  # noqa: E402
 from ponyc_tpu.platforms import auto_backend  # noqa: E402
 
-auto_backend()      # never hang on a wedged TPU plugin
 
-width = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/mandelbrot.pbm"
-grid = mandelbrot.render(width, width)
-mandelbrot.write_pbm(out, grid, width)
-inside = sum(bin(b).count("1") for b in grid.tobytes())
-print(f"{width}x{width}: {inside} pixels in the set -> {out}")
+def main():
+    auto_backend()      # never hang on a wedged TPU plugin
+    width = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/mandelbrot.pbm"
+    grid = mandelbrot.render(width, width)
+    mandelbrot.write_pbm(out, grid, width)
+    inside = sum(bin(b).count("1") for b in grid.tobytes())
+    print(f"{width}x{width}: {inside} pixels in the set -> {out}")
+
+
+if __name__ == "__main__":
+    main()

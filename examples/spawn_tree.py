@@ -42,6 +42,13 @@ class Node:
         return {**st, "acc": jnp.where(done, 0, acc), "pending": pending}
 
 
+# The host only ever injects grow (main() below); declaring the inject
+# site lets `python -m ponyc_tpu lint examples.spawn_tree` run the
+# ROOTED rules too — R1 reachability from grow, R2's nothing-spawns-it
+# check (Node spawns itself on device, so the program is clean).
+LINT_ROOTS = (Node.grow,)
+
+
 def main():
     auto_backend()      # never hang on a wedged TPU plugin
     depth = 6                     # 2^6 = 64 leaves, 127 nodes

@@ -14,8 +14,6 @@ import numpy as np  # noqa: E402
 from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor,  # noqa
                        behaviour)
 from ponyc_tpu.platforms import auto_backend  # noqa: E402
-
-auto_backend()      # never hang on a wedged TPU plugin
 from ponyc_tpu.stdlib import backpressure as bp  # noqa: E402
 
 
@@ -49,35 +47,42 @@ class Send:
         return {**st, "sent": st["sent"] + 1}
 
 
-rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, msg_words=1,
-                            max_sends=2, spill_cap=256, inject_slots=8))
-rt.declare(Send, 1).declare(SlowSink, 1).start()
-sink = rt.spawn(SlowSink)
-sender = rt.spawn(Send, out=sink)
-rt.send(sender, Send.tick, 0)
+def main():
+    auto_backend()      # never hang on a wedged TPU plugin
+    rt = Runtime(RuntimeOptions(mailbox_cap=8, batch=1, msg_words=1,
+                                max_sends=2, spill_cap=256,
+                                inject_slots=8))
+    rt.declare(Send, 1).declare(SlowSink, 1).start()
+    sink = rt.spawn(SlowSink)
+    sender = rt.spawn(Send, out=sink)
+    rt.send(sender, Send.tick, 0)
 
-auth = bp.ApplyReleaseBackpressureAuth(rt.ambient_auth())
-st, inj = rt.state, rt._empty_inject
-st, _ = rt._step(st, *rt._drain_inject())
-phase = []
-for step in range(40):
-    st, aux = rt._step(st, *inj)
-    rt.state = st
-    muted = bool(np.asarray(st.muted)[sender])
-    if step == 9:
-        bp.apply(auth, sink)        # the "socket stalled" moment
-        st = rt.state               # pick up the pressured column
-        phase.append(f"step {step}: pressure APPLIED")
-    if step == 29:
-        bp.release(auth, sink)      # drained: release
-        st = rt.state
-        phase.append(f"step {step}: pressure RELEASED")
-    if step in (8, 15, 35):
-        phase.append(f"step {step}: sender muted={muted}, "
-                     f"sink got={rt.state_of(sink)['got']}")
-for line in phase:
-    print(line)
-assert bool(np.asarray(rt.state.muted)[sender]) is False
-g1 = rt.state_of(sink)["got"]
-print(f"done: sink received {g1} chunks; sender muted while pressured, "
-      "released after")
+    auth = bp.ApplyReleaseBackpressureAuth(rt.ambient_auth())
+    st, inj = rt.state, rt._empty_inject
+    st, _ = rt._step(st, *rt._drain_inject())
+    phase = []
+    for step in range(40):
+        st, aux = rt._step(st, *inj)
+        rt.state = st
+        muted = bool(np.asarray(st.muted)[sender])
+        if step == 9:
+            bp.apply(auth, sink)    # the "socket stalled" moment
+            st = rt.state           # pick up the pressured column
+            phase.append(f"step {step}: pressure APPLIED")
+        if step == 29:
+            bp.release(auth, sink)  # drained: release
+            st = rt.state
+            phase.append(f"step {step}: pressure RELEASED")
+        if step in (8, 15, 35):
+            phase.append(f"step {step}: sender muted={muted}, "
+                         f"sink got={rt.state_of(sink)['got']}")
+    for line in phase:
+        print(line)
+    assert bool(np.asarray(rt.state.muted)[sender]) is False
+    g1 = rt.state_of(sink)["got"]
+    print(f"done: sink received {g1} chunks; sender muted while "
+          "pressured, released after")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,9 +16,21 @@ Commands:
   test [pytest args...]       the test suite (≙ ponytest aggregate).
   doc <module[:ATTR]> [-o D]  generate docs for actor types reachable
                               from a module (≙ docgen pass, docgen.c).
-  verify <module>             probe-trace every behaviour's effect
+  verify <module> [--json]    probe-trace every behaviour's effect
                               signature; fail on budget violations
                               (≙ the verify stage, verify/fun.c).
+                              Exit: 0 ok, 1 violations, 2 usage,
+                              3 no actor types in the module.
+  lint <module> [--json]      whole-program static analysis over the
+      [--roots A.go,B.tick]   module's actor types: message-flow graph
+                              + rule passes R1 reachability, R2
+                              dead-letter, R3 capability/race, R4
+                              amplification/overflow, R5 budget
+                              feasibility (≙ reach/paint + safeto;
+                              ponyc_tpu/lint/rules.py). --json emits
+                              one finding object per line. Exit codes
+                              as for verify (1 = findings at error or
+                              warning severity).
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -136,27 +148,43 @@ def cmd_doc(argv) -> int:
     return 0
 
 
-def cmd_verify(argv) -> int:
-    """Run the verify pass over a module's actor types (≙ the verify
-    stage of the compile pipeline, verify/fun.c): print each
-    behaviour's effect signature, fail on budget violations."""
-    if not argv:
-        print("ponyc_tpu verify: missing module", file=sys.stderr)
-        return 2
+def _load_module_types(cmd: str, modname: str):
+    """Import a module and collect its concrete actor types (shared by
+    verify/lint). Returns (module, types) or (None, exit_code)."""
     import importlib
 
     from .api import ActorTypeMeta
-    from .verify import VerifyError
     sys.path.insert(0, os.getcwd())
-    mod = importlib.import_module(argv[0])
+    mod = importlib.import_module(modname)
     atypes = [v for v in vars(mod).values()
               if isinstance(v, ActorTypeMeta)
+              and v.behaviour_defs
               and not getattr(v, "_type_params", ())]
     if not atypes:
-        print(f"ponyc_tpu verify: no concrete actor types in {argv[0]}",
+        print(f"ponyc_tpu {cmd}: no concrete actor types in {modname}",
               file=sys.stderr)
-        return 1
-    from .verify import verify_behaviour
+        return None, 3
+    return mod, atypes
+
+
+def cmd_verify(argv) -> int:
+    """Run the verify pass over a module's actor types (≙ the verify
+    stage of the compile pipeline, verify/fun.c): print each
+    behaviour's effect signature, fail on budget violations.
+
+    Exit codes: 0 all behaviours verify, 1 budget/trace violations,
+    2 usage error, 3 module has no concrete actor types. `--json`
+    emits failures in the lint finding format (one object per line)."""
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if not argv:
+        print("ponyc_tpu verify: missing module", file=sys.stderr)
+        return 2
+    mod, atypes = _load_module_types("verify", argv[0])
+    if mod is None:
+        return atypes
+    from .lint.rules import Finding
+    from .verify import VerifyError, verify_behaviour
     bad = 0
     for atype in atypes:
         for bdef in atype.behaviour_defs:
@@ -166,12 +194,72 @@ def cmd_verify(argv) -> int:
                 # Budget violations AND trace-time failures
                 # (sendability/capability errors) report as FAILs, not
                 # tracebacks, and the sweep continues.
-                print(f"FAIL {atype.__name__}.{bdef.name}: {e}")
+                if as_json:
+                    print(Finding("VERIFY", "error", atype.__name__,
+                                  bdef.name, str(e)).json_line())
+                else:
+                    print(f"FAIL {atype.__name__}.{bdef.name}: {e}")
                 bad += 1
                 continue
-            marks = eff.marks() or "pure state update"
-            print(f"ok   {atype.__name__}.{bdef.name}: {marks}")
+            if not as_json:
+                marks = eff.marks() or "pure state update"
+                print(f"ok   {atype.__name__}.{bdef.name}: {marks}")
     return 1 if bad else 0
+
+
+def cmd_lint(argv) -> int:
+    """Whole-program lint over a module's actor types (≙ reach/paint +
+    the capability checks run program-wide; ponyc_tpu/lint): build the
+    message-flow graph from probe traces and run rules R1–R5.
+
+    Roots (host inject sites) come from --roots / the module's
+    LINT_ROOTS / actor-type LINT_ROOTS; with none declared, any
+    behaviour is assumed injectable (R1 and the rooted R2 sub-rule
+    stay quiet). Exit codes: 0 clean (info-severity findings are
+    advisory), 1 findings at warning/error, 2 usage, 3 no types."""
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    roots = None
+    if "--roots" in argv:
+        i = argv.index("--roots")
+        if i + 1 >= len(argv):
+            print("ponyc_tpu lint: --roots needs a value "
+                  "(e.g. --roots Main.create,Ring.token)",
+                  file=sys.stderr)
+            return 2
+        roots = [r for r in argv[i + 1].split(",") if r]
+        argv = argv[:i] + argv[i + 2:]
+    if not argv:
+        print("ponyc_tpu lint: missing module", file=sys.stderr)
+        return 2
+    mod, atypes = _load_module_types("lint", argv[0])
+    if mod is None:
+        return atypes
+    from .lint import findings_to_json, format_findings, lint_types
+    if roots is None:
+        roots = getattr(mod, "LINT_ROOTS", None)
+    try:
+        findings = lint_types(*atypes, roots=roots)
+    except (TypeError, ValueError) as e:
+        print(f"ponyc_tpu lint: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        out = findings_to_json(findings)
+        if out:
+            print(out)
+    else:
+        if findings:
+            print(format_findings(findings))
+        n_beh = sum(len(t.behaviour_defs) for t in atypes)
+        by_sev = {}
+        for f in findings:
+            by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+        summary = (", ".join(f"{n} {s}" for s, n in sorted(by_sev.items()))
+                   or "clean")
+        print(f"lint: {len(atypes)} type(s), {n_beh} behaviour(s): "
+              f"{summary}")
+    return 1 if any(f.severity in ("error", "warning")
+                    for f in findings) else 0
 
 
 def cmd_trace(argv) -> int:
@@ -209,8 +297,8 @@ def cmd_version(_argv) -> int:
 
 
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
-            "doc": cmd_doc, "verify": cmd_verify, "trace": cmd_trace,
-            "version": cmd_version}
+            "doc": cmd_doc, "verify": cmd_verify, "lint": cmd_lint,
+            "trace": cmd_trace, "version": cmd_version}
 
 
 def main(argv=None) -> int:

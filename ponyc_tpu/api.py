@@ -378,19 +378,18 @@ class Context:
         self._blob: Optional[BlobPoolView] = blob
 
     # -- messaging (≙ pony_sendv, actor.c:773-834) --
-    def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
-        if not isinstance(behaviour_def, BehaviourDef):
-            raise TypeError("second argument to send() must be a behaviour "
-                            "(e.g. SomeActor.some_behaviour)")
-        if behaviour_def.global_id is None:
-            raise RuntimeError(
-                f"{behaviour_def} not registered in a Program yet")
-        # Sendability checks (≙ type/safeto.c + expr/call.c: a behaviour
-        # call must exist on the receiver's type, and ref-typed params
-        # only accept matching refs). Typed provenance rides on tracer
-        # identity (pack.RefTypes) — a directly-forwarded typed field or
-        # argument is checked; derived values are untyped (gradual).
-        # Fails the TRACE (build time), not as a runtime badmsg.
+    def _send_checks(self, target, behaviour_def: BehaviourDef, args):
+        """Trace-time sendability + capability discipline for one send,
+        shared by the real send and the verify/lint probe
+        (verify._ProbeContext) so whole-program lint enforces exactly
+        what the engine's trace would.
+
+        Sendability (≙ type/safeto.c + expr/call.c): a behaviour call
+        must exist on the receiver's type, and ref-typed params only
+        accept matching refs. Typed provenance rides on tracer identity
+        (pack.RefTypes) — a directly-forwarded typed field or argument
+        is checked; derived values are untyped (gradual). Fails the
+        TRACE (build time), not as a runtime badmsg."""
         owner = behaviour_def.actor_type.__name__
         tn = self.ref_types.lookup(target)
         if tn is not None and tn != owner:
@@ -439,6 +438,15 @@ class Context:
             if want == "iso" or (want is not None
                                  and self.cap_types.lookup(a) == "iso"):
                 self.cap_moves.move(a, where)
+
+    def send(self, target, behaviour_def: BehaviourDef, *args, when=True):
+        if not isinstance(behaviour_def, BehaviourDef):
+            raise TypeError("second argument to send() must be a behaviour "
+                            "(e.g. SomeActor.some_behaviour)")
+        if behaviour_def.global_id is None:
+            raise RuntimeError(
+                f"{behaviour_def} not registered in a Program yet")
+        self._send_checks(target, behaviour_def, args)
         payload = pack.pack_args(behaviour_def.arg_specs, args, self.msg_words)
         # Planar-aware: payload is [W] (all-constant args) or [W, R]
         # (lane vectors); the gid row matches its trailing shape.
@@ -514,40 +522,7 @@ class Context:
             raise RuntimeError(
                 "spawn_sync is only available in device behaviours")
         used = len(self.spawn_claims[tname]) - 1   # site just claimed
-        # Constructor arguments obey the same sendability + capability
-        # rules as a send (≙ expr/call.c parameter checks): a typed ref
-        # arg must match, a cap-typed arg must satisfy the store
-        # lattice, and handing a unique to the newborn is a MOVE.
-        where = f"{tname}.{ctor.name} spawn_sync"
-        for spec, a in zip(ctor.arg_specs, args):
-            want = pack.ref_target(spec)
-            got = self.ref_types.lookup(a)
-            if want is not None and got is not None and got != want:
-                raise TypeError(
-                    f"sendability: {tname}.{ctor.name} expects Ref[{want}] "
-                    f"but was passed a Ref[{got}]")
-            if pack.concrete_null_handle(a):
-                continue
-            prev = self.cap_moves.was_moved(a)
-            if prev is not None:
-                raise TypeError(
-                    f"capability: use-after-move — payload already moved "
-                    f"by {prev} is passed to {where}")
-            cwant = pack.cap_mode(spec)
-            src = self.cap_types.lookup(a)
-            if not pack.cap_store_ok(src, cwant):
-                raise TypeError(
-                    f"capability: {where} declares its parameter "
-                    f"{cwant.capitalize()} but was passed a {src} "
-                    f"payload — a {src} value cannot grant the rights "
-                    f"{cwant} requires (is_cap_sub_cap, type/cap.c)")
-        for spec, a in zip(ctor.arg_specs, args):
-            if pack.concrete_null_handle(a):
-                continue
-            cwant = pack.cap_mode(spec)
-            if cwant == "iso" or (cwant is not None
-                                  and self.cap_types.lookup(a) == "iso"):
-                self.cap_moves.move(a, where)
+        self._ctor_arg_checks(ctor, args, tname)
         # Run the constructor NOW on zeroed defaults (≙ the synchronous
         # field assignment), in a throwaway context that must stay inert.
         cctx = Context(ref, self.msg_words)
@@ -596,6 +571,44 @@ class Context:
                     "actor boundary (CAP_SEND, type/cap.c:90)")
         self.sync_inits.setdefault(tname, {})[used] = (st2, ok)
         return self.ref_types.tag(jnp.where(ok, ref, jnp.int32(-1)), tname)
+
+    def _ctor_arg_checks(self, ctor: BehaviourDef, args, tname: str):
+        """Constructor arguments obey the same sendability + capability
+        rules as a send (≙ expr/call.c parameter checks): a typed ref
+        arg must match, a cap-typed arg must satisfy the store lattice,
+        and handing a unique to the newborn is a MOVE. Shared with the
+        verify/lint probe (verify._ProbeContext.spawn_sync), which
+        claims the slot but never runs the constructor."""
+        where = f"{tname}.{ctor.name} spawn_sync"
+        for spec, a in zip(ctor.arg_specs, args):
+            want = pack.ref_target(spec)
+            got = self.ref_types.lookup(a)
+            if want is not None and got is not None and got != want:
+                raise TypeError(
+                    f"sendability: {tname}.{ctor.name} expects Ref[{want}] "
+                    f"but was passed a Ref[{got}]")
+            if pack.concrete_null_handle(a):
+                continue
+            prev = self.cap_moves.was_moved(a)
+            if prev is not None:
+                raise TypeError(
+                    f"capability: use-after-move — payload already moved "
+                    f"by {prev} is passed to {where}")
+            cwant = pack.cap_mode(spec)
+            src = self.cap_types.lookup(a)
+            if not pack.cap_store_ok(src, cwant):
+                raise TypeError(
+                    f"capability: {where} declares its parameter "
+                    f"{cwant.capitalize()} but was passed a {src} "
+                    f"payload — a {src} value cannot grant the rights "
+                    f"{cwant} requires (is_cap_sub_cap, type/cap.c)")
+        for spec, a in zip(ctor.arg_specs, args):
+            if pack.concrete_null_handle(a):
+                continue
+            cwant = pack.cap_mode(spec)
+            if cwant == "iso" or (cwant is not None
+                                  and self.cap_types.lookup(a) == "iso"):
+                self.cap_moves.move(a, where)
 
     def destroy(self, when=True):
         """Mark *this* actor for destruction at the end of the step: slot

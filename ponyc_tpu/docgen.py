@@ -33,8 +33,15 @@ def _sig(bdef) -> str:
     return f"{bdef.name}({args})"
 
 
-def document_type(atype: ActorTypeMeta) -> str:
-    """Markdown for one actor type (≙ doc_entity in docgen.c)."""
+def document_type(atype: ActorTypeMeta, lint_notes=None) -> str:
+    """Markdown for one actor type (≙ doc_entity in docgen.c).
+
+    `lint_notes` (optional): {behaviour name or None: [note, ...]} from
+    the whole-program lint pass — type-level notes (key None) render
+    under the hints line, behaviour notes under each signature (the
+    unreachable/dead-letter marks, ≙ docgen flagging pruned entities).
+    """
+    lint_notes = lint_notes or {}
     lines: List[str] = [f"## actor {atype.__name__}", ""]
     doc = inspect.getdoc(atype)
     if doc:
@@ -53,6 +60,8 @@ def document_type(atype: ActorTypeMeta) -> str:
         hints.append(f"SPAWNS({sp})")
     if hints:
         lines += ["*" + "; ".join(hints) + "*", ""]
+    for note in lint_notes.get(None, ()):
+        lines += [f"> **lint:** {note}", ""]
     if atype.field_specs:
         lines += ["| field | type |", "|---|---|"]
         for fname, spec in atype.field_specs.items():
@@ -71,6 +80,8 @@ def document_type(atype: ActorTypeMeta) -> str:
                 lines += [f"*effects: {marks}*", ""]
         except Exception:                    # noqa: BLE001 — doc only
             pass
+        for note in lint_notes.get(bdef.name, ()):
+            lines += [f"> **lint:** {note}", ""]
         bdoc = inspect.getdoc(bdef.fn)
         if bdoc:
             lines += [bdoc, ""]
@@ -84,9 +95,29 @@ def document_types(*atypes: ActorTypeMeta, title: str = "Actors") -> str:
     return "\n".join(parts)
 
 
-def document(program, title: str = "Program") -> str:
+def _lint_notes_by_type(program, roots=None):
+    """{type name: {behaviour or None: [note, ...]}} from the lint
+    pass — unreachable (R1) / dead-letter (R2) and the rest become doc
+    marks. Doc generation must never fail on an unlintable program."""
+    notes: dict = {}
+    try:
+        from .lint import lint_program
+        for f in lint_program(program, roots=roots):
+            notes.setdefault(f.type_name, {}).setdefault(
+                f.behaviour, []).append(f"{f.rule} [{f.severity}] "
+                                        f"{f.message}")
+    except Exception:                        # noqa: BLE001 — doc only
+        pass
+    return notes
+
+
+def document(program, title: str = "Program", lint: bool = True,
+             lint_roots=None) -> str:
     """Full program docs incl. the dispatch table (≙ docgen emitting the
-    whole package tree after reach/paint assigned vtable slots)."""
+    whole package tree after reach/paint assigned vtable slots). With
+    `lint=True` the whole-program lint findings render as per-type /
+    per-behaviour marks (unreachable, dead-letter, …); pass
+    `lint_roots` to enable the rooted rules (see ponyc_tpu.lint)."""
     parts = [f"# {title}", "",
              f"{program.total} actor slots over {program.shards} "
              f"shard(s); {len(program.behaviour_table)} behaviours.", ""]
@@ -95,21 +126,25 @@ def document(program, title: str = "Program") -> str:
         parts.append(f"| {gid} | {_sig(bdef)} | "
                      f"{bdef.actor_type.__name__} |")
     parts.append("")
+    notes = _lint_notes_by_type(program, lint_roots) if lint else {}
     for cohort in program.cohorts:
-        parts.append(document_type(cohort.atype))
+        parts.append(document_type(cohort.atype,
+                                   notes.get(cohort.atype.__name__)))
     return "\n".join(parts)
 
 
-def write_tree(program, out_dir: str, title: str = "Program") -> List[str]:
+def write_tree(program, out_dir: str, title: str = "Program",
+               lint: bool = True, lint_roots=None) -> List[str]:
     """One markdown file per type + an index (≙ the mkdocs tree)."""
     os.makedirs(out_dir, exist_ok=True)
     written = []
+    notes = _lint_notes_by_type(program, lint_roots) if lint else {}
     index = [f"# {title}", "", "## Types", ""]
     for cohort in program.cohorts:
         name = cohort.atype.__name__
         path = os.path.join(out_dir, f"{name}.md")
         with open(path, "w") as f:
-            f.write(document_type(cohort.atype))
+            f.write(document_type(cohort.atype, notes.get(name)))
         index.append(f"- [{name}]({name}.md)")
         written.append(path)
     idx = os.path.join(out_dir, "index.md")

@@ -303,6 +303,20 @@ class Program:
             offset += (cohort.local_capacity * cohort.blob_dispatches
                        * cohort.blob_sites)
 
+    def lint(self, roots=None):
+        """Whole-program static analysis over this program's world
+        (≙ running reach/paint + safeto ahead of codegen): returns the
+        list of lint Findings — see ponyc_tpu.lint for the rules
+        (R1 reachability … R5 budget feasibility), roots, and
+        suppressions. Callable before or after finalize(); probes with
+        this program's own msg_words/max_sends resolution."""
+        from .lint import lint_types
+        declared = (self._declared if not self.frozen
+                    else [(c.atype, 0) for c in self.cohorts])
+        return lint_types(*(t for t, _ in declared), roots=roots,
+                          msg_words=self.opts.msg_words,
+                          default_max_sends=self.opts.max_sends)
+
     @property
     def has_device_spawns(self) -> bool:
         return any(c.spawns for c in self.cohorts)
