@@ -21,16 +21,21 @@ Commands:
                               (≙ the verify stage, verify/fun.c).
                               Exit: 0 ok, 1 violations, 2 usage,
                               3 no actor types in the module.
-  lint <module> [--json]      whole-program static analysis over the
-      [--roots A.go,B.tick]   module's actor types: message-flow graph
-                              + rule passes R1 reachability, R2
-                              dead-letter, R3 capability/race, R4
-                              amplification/overflow, R5 budget
-                              feasibility (≙ reach/paint + safeto;
-                              ponyc_tpu/lint/rules.py). --json emits
-                              one finding object per line. Exit codes
-                              as for verify (1 = findings at error or
-                              warning severity).
+  lint <target...> [--json]   whole-program static analysis. A target
+      [--format github]       is a MODULE NAME (message-flow graph
+      [--roots A.go,B.tick]   rules R1–R5 over probe traces PLUS the
+                              pure-AST behaviour-body rules R6–R9) or
+                              a FILE/DIRECTORY (`lint examples/`
+                              sweeps the tree with the body rules
+                              only — no import, no JAX; files that
+                              don't even import still lint).
+                              --json emits one finding object per
+                              line ({rule, severity, type, behaviour,
+                              message, file, line}); --format github
+                              emits ::warning/::error workflow
+                              annotations. Exit codes as for verify
+                              (1 = findings at error or warning
+                              severity).
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -184,7 +189,7 @@ def cmd_verify(argv) -> int:
     if mod is None:
         return atypes
     from .lint.rules import Finding
-    from .verify import VerifyError, verify_behaviour
+    from .verify import VerifyError, behaviour_location, verify_behaviour
     bad = 0
     for atype in atypes:
         for bdef in atype.behaviour_defs:
@@ -194,9 +199,11 @@ def cmd_verify(argv) -> int:
                 # Budget violations AND trace-time failures
                 # (sendability/capability errors) report as FAILs, not
                 # tracebacks, and the sweep continues.
+                file, line = behaviour_location(bdef)
                 if as_json:
                     print(Finding("VERIFY", "error", atype.__name__,
-                                  bdef.name, str(e)).json_line())
+                                  bdef.name, str(e), file=file,
+                                  line=line).json_line())
                 else:
                     print(f"FAIL {atype.__name__}.{bdef.name}: {e}")
                 bad += 1
@@ -208,17 +215,34 @@ def cmd_verify(argv) -> int:
 
 
 def cmd_lint(argv) -> int:
-    """Whole-program lint over a module's actor types (≙ reach/paint +
-    the capability checks run program-wide; ponyc_tpu/lint): build the
-    message-flow graph from probe traces and run rules R1–R5.
+    """Whole-program lint (≙ reach/paint + the capability checks run
+    program-wide, plus the compiler's syntactic body checks;
+    ponyc_tpu/lint). Targets are module names (graph rules R1–R5 from
+    probe traces + body rules R6–R9) and/or file/directory paths
+    (`lint examples/` — body rules only, pure AST: the files are
+    PARSED, never imported, so a file whose imports are broken still
+    lints, with no JAX in the loop).
 
     Roots (host inject sites) come from --roots / the module's
     LINT_ROOTS / actor-type LINT_ROOTS; with none declared, any
     behaviour is assumed injectable (R1 and the rooted R2 sub-rule
-    stay quiet). Exit codes: 0 clean (info-severity findings are
-    advisory), 1 findings at warning/error, 2 usage, 3 no types."""
-    as_json = "--json" in argv
-    argv = [a for a in argv if a != "--json"]
+    stay quiet). Output: human (default), --json (one object per
+    line), --format github (::warning/::error annotations). Exit
+    codes: 0 clean (info-severity findings are advisory), 1 findings
+    at warning/error, 2 usage, 3 no actor types found."""
+    fmt = "human"
+    if "--json" in argv:
+        fmt = "json"
+        argv = [a for a in argv if a != "--json"]
+    if "--format" in argv:
+        i = argv.index("--format")
+        if i + 1 >= len(argv) or argv[i + 1] not in ("human", "json",
+                                                     "github"):
+            print("ponyc_tpu lint: --format takes human|json|github",
+                  file=sys.stderr)
+            return 2
+        fmt = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     roots = None
     if "--roots" in argv:
         i = argv.index("--roots")
@@ -230,33 +254,53 @@ def cmd_lint(argv) -> int:
         roots = [r for r in argv[i + 1].split(",") if r]
         argv = argv[:i] + argv[i + 2:]
     if not argv:
-        print("ponyc_tpu lint: missing module", file=sys.stderr)
+        print("ponyc_tpu lint: missing module or path", file=sys.stderr)
         return 2
-    mod, atypes = _load_module_types("lint", argv[0])
-    if mod is None:
-        return atypes
-    from .lint import findings_to_json, format_findings, lint_types
-    if roots is None:
-        roots = getattr(mod, "LINT_ROOTS", None)
-    try:
-        findings = lint_types(*atypes, roots=roots)
-    except (TypeError, ValueError) as e:
-        print(f"ponyc_tpu lint: {e}", file=sys.stderr)
-        return 2
-    if as_json:
+    from .lint import (check_paths, findings_to_github,
+                       findings_to_json, format_findings, lint_types)
+    findings = []
+    n_types = n_beh = 0
+    paths = [a for a in argv if os.path.exists(a)]
+    modules = [a for a in argv if a not in paths]
+    if paths:
+        pf, pt, pb = check_paths(paths)
+        findings += pf
+        n_types += pt
+        n_beh += pb
+    for modname in modules:
+        mod, atypes = _load_module_types("lint", modname)
+        if mod is None:
+            return atypes
+        mroots = roots if roots is not None else getattr(
+            mod, "LINT_ROOTS", None)
+        try:
+            findings += lint_types(*atypes, roots=mroots)
+        except (TypeError, ValueError) as e:
+            print(f"ponyc_tpu lint: {e}", file=sys.stderr)
+            return 2
+        n_types += len(atypes)
+        n_beh += sum(len(t.behaviour_defs) for t in atypes)
+    if not n_types:
+        print("ponyc_tpu lint: no actor types found in "
+              + ", ".join(argv), file=sys.stderr)
+        return 3
+    if fmt == "json":
         out = findings_to_json(findings)
+        if out:
+            print(out)
+    elif fmt == "github":
+        out = findings_to_github(findings)
         if out:
             print(out)
     else:
         if findings:
             print(format_findings(findings))
-        n_beh = sum(len(t.behaviour_defs) for t in atypes)
         by_sev = {}
         for f in findings:
             by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
         summary = (", ".join(f"{n} {s}" for s, n in sorted(by_sev.items()))
                    or "clean")
-        print(f"lint: {len(atypes)} type(s), {n_beh} behaviour(s): "
+        print(f"lint: {n_types} type(s), {n_beh} behaviour(s): "
               f"{summary}")
     return 1 if any(f.severity in ("error", "warning")
                     for f in findings) else 0

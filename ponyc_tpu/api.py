@@ -76,6 +76,18 @@ class BehaviourDef:
                     f"{spec.__name__} — not sendable; only Iso, Val and "
                     "Tag payloads may cross an actor boundary "
                     "(CAP_SEND, type/cap.c:90; safeto.c)")
+        # Source capture (the lint body rules + verify failures point
+        # at real file:line; None for exec'd/builtin functions):
+        code = getattr(fn, "__code__", None)
+        self.source_file: Optional[str] = getattr(code, "co_filename",
+                                                  None)
+        self.source_line: Optional[int] = getattr(code,
+                                                  "co_firstlineno", None)
+        # Behaviour-level lint suppressions (``@behaviour(lint_ignore=
+        # ("R6",))`` sets fn.LINT_IGNORE so inherited/reified copies —
+        # which re-wrap the same fn — keep the suppression).
+        self.lint_ignore: Tuple[str, ...] = tuple(
+            str(r) for r in getattr(fn, "LINT_IGNORE", ()) or ())
         # Filled in by program build:
         self.global_id: Optional[int] = None
         self.local_id: Optional[int] = None
@@ -86,8 +98,18 @@ class BehaviourDef:
         return f"<behaviour {owner}.{self.name} gid={self.global_id}>"
 
 
-def behaviour(fn):
-    """Mark a method as an actor behaviour (≙ Pony ``be``)."""
+def behaviour(fn=None, *, lint_ignore=()):
+    """Mark a method as an actor behaviour (≙ Pony ``be``).
+
+    ``@behaviour(lint_ignore=("R6", ...))`` suppresses those lint
+    rules for findings attributed to this behaviour (the
+    behaviour-level sibling of the type-level ``LINT_IGNORE``)."""
+    if fn is None:
+        def deco(f):
+            if lint_ignore:
+                f.LINT_IGNORE = tuple(str(r) for r in lint_ignore)
+            return BehaviourDef(f)
+        return deco
     return BehaviourDef(fn)
 
 

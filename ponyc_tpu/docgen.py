@@ -97,15 +97,18 @@ def document_types(*atypes: ActorTypeMeta, title: str = "Actors") -> str:
 
 def _lint_notes_by_type(program, roots=None):
     """{type name: {behaviour or None: [note, ...]}} from the lint
-    pass — unreachable (R1) / dead-letter (R2) and the rest become doc
-    marks. Doc generation must never fail on an unlintable program."""
+    pass — unreachable (R1) / dead-letter (R2) and the body-rule
+    findings (R6–R9, with their file:line) become doc marks. Doc
+    generation must never fail on an unlintable program."""
     notes: dict = {}
     try:
         from .lint import lint_program
         for f in lint_program(program, roots=roots):
+            where = (f" ({os.path.basename(f.file)}:{f.line})"
+                     if f.file and f.line else "")
             notes.setdefault(f.type_name, {}).setdefault(
                 f.behaviour, []).append(f"{f.rule} [{f.severity}] "
-                                        f"{f.message}")
+                                        f"{f.message}{where}")
     except Exception:                        # noqa: BLE001 — doc only
         pass
     return notes

@@ -39,23 +39,37 @@ the rooted R2 sub-rule stay quiet. Declare roots to tighten:
 ``--roots``. Net/timer callback behaviours are inject sites too —
 list them.
 
-Suppressions: ``LINT_IGNORE = ("R4", ...)`` on the actor type
-suppresses those rules for findings attributed to that type.
+Suppressions, finest first: a trailing ``# lint: ignore[R6]`` (or
+bare ``# lint: ignore``) comment on the finding's source line;
+``@behaviour(lint_ignore=("R6", ...))`` on one behaviour;
+``LINT_IGNORE = ("R4", ...)`` on the actor type. All three are
+honoured by the graph rules (R0–R5) and the body rules (R6–R9) alike.
+
+The body rules (bodycheck.py) also run standalone over FILES — pure
+AST, no JAX, no import of the target: ``check_source``/``check_path``
+/ ``python -m ponyc_tpu lint some_dir/`` lint files that do not even
+import.
 """
 
 from __future__ import annotations
 
+import linecache
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import ActorTypeMeta, BehaviourDef
+from . import bodycheck
+from .bodycheck import check_path, check_paths, check_source
 from .facts import BehaviourFacts, TypeFacts, gather
 from .graph import Edge, FlowGraph, Node
-from .rules import SEVERITIES, Finding, run_rules
+from .rules import (SEVERITIES, Finding, line_suppressed, run_rules,
+                    sort_findings)
 
 __all__ = [
     "Finding", "FlowGraph", "Edge", "Node", "BehaviourFacts",
     "TypeFacts", "SEVERITIES", "lint_types", "lint_module",
-    "lint_program", "format_findings", "findings_to_json", "gather",
+    "lint_program", "format_findings", "findings_to_json",
+    "findings_to_github", "gather", "bodycheck", "check_path",
+    "check_paths", "check_source",
 ]
 
 
@@ -97,29 +111,48 @@ def _resolve_roots(roots, types: Dict[str, TypeFacts]
 def _suppress(findings: Sequence[Finding],
               types: Dict[str, TypeFacts]
               ) -> Tuple[List[Finding], List[Finding]]:
-    """Split into (active, suppressed) per the subject type's
-    LINT_IGNORE tuple."""
+    """Split into (active, suppressed): the subject type's LINT_IGNORE
+    tuple, the behaviour's own lint_ignore, and trailing
+    ``# lint: ignore[...]`` comments on the finding's source line."""
     active, muted = [], []
     for f in findings:
         tf = types.get(f.type_name)
-        (muted if tf is not None and f.rule in tf.ignore
-         else active).append(f)
+        if tf is not None and f.rule in tf.ignore:
+            muted.append(f)
+            continue
+        bf = None
+        if tf is not None and f.behaviour is not None:
+            bf = next((b for b in tf.behaviours
+                       if b.behaviour == f.behaviour), None)
+        if bf is not None and f.rule in bf.ignore:
+            muted.append(f)
+            continue
+        if f.file and f.line and line_suppressed(
+                f, linecache.getline(f.file, f.line)):
+            muted.append(f)
+            continue
+        active.append(f)
     return active, muted
 
 
 def lint_types(*atypes: ActorTypeMeta, roots=None, msg_words: int = 8,
                default_max_sends: int = 2,
                include_suppressed: bool = False) -> List[Finding]:
-    """Lint a world of concrete actor types. `roots` (optional):
-    behaviours the host injects into — BehaviourDefs,
-    'Type.behaviour' strings, or (type, behaviour) pairs; merged with
-    any LINT_ROOTS class declarations. Returns findings sorted most
-    severe first; LINT_IGNORE-suppressed findings are dropped unless
+    """Lint a world of concrete actor types: the probe-fact graph
+    rules (R0–R5) plus the pure-AST behaviour-body rules (R6–R9,
+    bodycheck.py). `roots` (optional): behaviours the host injects
+    into — BehaviourDefs, 'Type.behaviour' strings, or (type,
+    behaviour) pairs; merged with any LINT_ROOTS class declarations.
+    Returns findings sorted most severe first; suppressed findings
+    (LINT_IGNORE / lint_ignore / line comments) are dropped unless
     `include_suppressed`."""
     types = gather(atypes, msg_words=msg_words,
                    default_max_sends=default_max_sends)
     g = FlowGraph(types)
     findings = run_rules(g, _resolve_roots(roots, types))
+    findings = sort_findings(
+        findings + bodycheck.check_types(*atypes,
+                                         include_suppressed=True))
     if include_suppressed:
         return findings
     active, _ = _suppress(findings, types)
@@ -167,5 +200,12 @@ def format_findings(findings: Sequence[Finding]) -> str:
 
 def findings_to_json(findings: Sequence[Finding]) -> str:
     """Machine-diffable report: one JSON object per line with stable
-    keys {rule, severity, type, behaviour, message}."""
+    keys {rule, severity, type, behaviour, message, file, line}
+    (file/line null when unknown)."""
     return "\n".join(f.json_line() for f in findings)
+
+
+def findings_to_github(findings: Sequence[Finding]) -> str:
+    """GitHub Actions annotations, one ``::warning file=…,line=…::``
+    command per finding (the CLI's ``--format github``)."""
+    return "\n".join(f.github_line() for f in findings)

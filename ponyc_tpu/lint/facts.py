@@ -19,11 +19,13 @@ use_main_thread actors, scheduler.c:179).
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Dict, List, Optional, Tuple
 
 from ..api import ActorTypeMeta
 from ..ops import pack
-from ..verify import Effects, SendFact, behaviour_effects, probe_behaviour
+from ..verify import (Effects, SendFact, behaviour_effects,
+                      behaviour_location, probe_behaviour)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,6 +42,9 @@ class BehaviourFacts:
     blob_freeze_sites: int = 0
     error: Optional[str] = None        # probe raised: the message
     error_kind: Optional[str] = None   # "capability"|"sendability"|"trace"
+    file: Optional[str] = None         # def site (behaviour_location)
+    line: Optional[int] = None
+    ignore: Tuple[str, ...] = ()       # behaviour-level LINT_IGNORE
 
     @property
     def node(self) -> Tuple[str, str]:
@@ -58,6 +63,8 @@ class TypeFacts:
     ignore: Tuple[str, ...]              # LINT_IGNORE rule ids
     roots_declared: Tuple[str, ...]      # LINT_ROOTS behaviour names
     behaviours: Tuple[BehaviourFacts, ...]
+    file: Optional[str] = None           # class def site, if derivable
+    line: Optional[int] = None
 
     def blob_specs(self):
         """(where, spec) for every Blob/BlobVal field or parameter —
@@ -94,10 +101,14 @@ def gather_type(atype: ActorTypeMeta, msg_words: int = 8,
         for r in getattr(atype, "LINT_ROOTS", ()) or ())
     bfs: List[BehaviourFacts] = []
     for bdef in atype.behaviour_defs:
+        bfile, bline = behaviour_location(bdef)
+        bignore = tuple(getattr(bdef, "lint_ignore", ()) or ()) + tuple(
+            str(r) for r in getattr(bdef, "LINT_IGNORE", ()) or ())
         if host:
             bfs.append(BehaviourFacts(
                 type_name=name, behaviour=bdef.name, host=True,
-                effects=behaviour_effects(bdef, atype)))
+                effects=behaviour_effects(bdef, atype),
+                file=bfile, line=bline, ignore=bignore))
             continue
         try:
             ctx = probe_behaviour(bdef, atype, msg_words=msg_words)
@@ -108,7 +119,8 @@ def gather_type(atype: ActorTypeMeta, msg_words: int = 8,
                                 can_destroy=False, can_exit=False,
                                 can_yield=False, spawns=(),
                                 sync_spawns=()),
-                error=str(e), error_kind=_classify(str(e))))
+                error=str(e), error_kind=_classify(str(e)),
+                file=bfile, line=bline, ignore=bignore))
             continue
         max_sends = (getattr(atype, "MAX_SENDS", None)
                      or int(default_max_sends))
@@ -130,12 +142,18 @@ def gather_type(atype: ActorTypeMeta, msg_words: int = 8,
             effects=eff, sends=tuple(ctx.send_facts),
             blob_alloc_whens=tuple(ctx.blob_alloc_whens),
             blob_free_sites=ctx.blob_free_sites,
-            blob_freeze_sites=ctx.blob_freeze_sites))
+            blob_freeze_sites=ctx.blob_freeze_sites,
+            file=bfile, line=bline, ignore=bignore))
+    try:
+        tfile = inspect.getsourcefile(atype)
+        tline = inspect.getsourcelines(atype)[1]
+    except (OSError, TypeError):         # reified/exec'd types
+        tfile, tline = (bfs[0].file, bfs[0].line) if bfs else (None, None)
     return TypeFacts(atype=atype, name=name, host=host,
                      spawns_declared=spawns, max_blobs=int(
                          getattr(atype, "MAX_BLOBS", 0) or 0),
                      ignore=ignore, roots_declared=roots,
-                     behaviours=tuple(bfs))
+                     behaviours=tuple(bfs), file=tfile, line=tline)
 
 
 def gather(atypes, msg_words: int = 8,

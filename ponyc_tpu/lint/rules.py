@@ -24,13 +24,20 @@ Rule ids are STABLE (suppressions and machine diffs key on them):
       message cycle exhaust the SPAWNS / blob pools [warning];
       declared budgets no site ever uses reserve pool slots for
       nothing [info].
+
+Rules R6–R9 are the behaviour-body SOURCE rules (bodycheck.py — pure
+AST, no trace, no import of the target): R6 traced-value control flow,
+R7 non-static effect sites, R8 state-key discipline, R9 host impurity
+and linear-handle misuse. Their findings carry exact file/line/col;
+the graph rules here attach the behaviour's def site where derivable.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Dict, List, Optional
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence
 
 from .graph import FlowGraph, Node
 
@@ -40,26 +47,89 @@ SEVERITIES = ("error", "warning", "info")
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One lint finding. Stable, machine-diffable identity: (rule,
-    type, behaviour, message)."""
+    type, behaviour, message); `file`/`line`/`col` locate the finding
+    in source where derivable (None = unknown)."""
 
-    rule: str                    # "R0".."R5"
+    rule: str                    # "R0".."R9"
     severity: str                # "error" | "warning" | "info"
     type_name: str               # subject actor type (suppression key)
     behaviour: Optional[str]     # None = type-level finding
     message: str
+    file: Optional[str] = None
+    line: Optional[int] = None
+    col: Optional[int] = None    # 1-based column, body rules only
 
     def __str__(self) -> str:
         loc = self.type_name + (f".{self.behaviour}" if self.behaviour
                                 else "")
-        return f"{self.rule} {self.severity:<7} {loc}: {self.message}"
+        src = (f"{self.file}:{self.line}: " if self.file and self.line
+               else "")
+        return f"{src}{self.rule} {self.severity:<7} {loc}: {self.message}"
 
     def to_obj(self) -> Dict[str, Optional[str]]:
         return {"rule": self.rule, "severity": self.severity,
                 "type": self.type_name, "behaviour": self.behaviour,
-                "message": self.message}
+                "message": self.message, "file": self.file,
+                "line": self.line}
 
     def json_line(self) -> str:
         return json.dumps(self.to_obj(), sort_keys=True)
+
+    def github_line(self) -> str:
+        """One GitHub Actions workflow annotation
+        (``::warning file=…,line=…::message``) — the `--format github`
+        CLI output; severities map error/warning/notice."""
+        level = {"error": "error", "warning": "warning",
+                 "info": "notice"}[self.severity]
+        props = [f"title=lint {self.rule}"]
+        if self.file:
+            props.insert(0, f"file={self.file}")
+            if self.line:
+                props.insert(1, f"line={self.line}")
+            if self.col:
+                props.insert(2, f"col={self.col}")
+        loc = self.type_name + (f".{self.behaviour}" if self.behaviour
+                                else "")
+        text = f"{self.rule} {loc}: {self.message}"
+        text = (text.replace("%", "%25").replace("\r", "%0D")
+                .replace("\n", "%0A"))
+        return f"::{level} {','.join(props)}::{text}"
+
+
+# ``# lint: ignore`` (all rules) / ``# lint: ignore[R6]`` /
+# ``# lint: ignore[R6, R8]`` — trailing-comment line suppressions,
+# honoured for every rule that can attach a source line.
+_IGNORE_RE = re.compile(
+    r"#\s*lint:\s*ignore(?:\s*\[([A-Za-z0-9_,\s]+)\])?")
+
+
+def ignored_rules_on_line(src_line: str) -> Optional[FrozenSet[str]]:
+    """Parse a source line's trailing lint-suppression comment:
+    None = no suppression; empty frozenset = suppress ALL rules;
+    otherwise the rule ids listed in the brackets."""
+    m = _IGNORE_RE.search(src_line)
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return frozenset()
+    return frozenset(r.strip() for r in m.group(1).split(",")
+                     if r.strip())
+
+
+def line_suppressed(f: Finding, src_line: str) -> bool:
+    """Does this source line's comment suppress this finding?"""
+    rules = ignored_rules_on_line(src_line)
+    return rules is not None and (not rules or f.rule in rules)
+
+
+def sort_findings(findings: Sequence[Finding]) -> List[Finding]:
+    """Stable report order: severity first, then rule/location — and
+    dedupe (Finding is frozen/hashable)."""
+    rank = {s: i for i, s in enumerate(SEVERITIES)}
+    return sorted(set(findings),
+                  key=lambda f: (rank[f.severity], f.rule, f.type_name,
+                                 f.behaviour or "", f.line or 0,
+                                 f.message))
 
 
 def _node_str(n: Node) -> str:
@@ -269,6 +339,28 @@ def rule_r5_budgets(graph: FlowGraph) -> List[Finding]:
     return out
 
 
+def attach_locations(findings: Sequence[Finding],
+                     graph: FlowGraph) -> List[Finding]:
+    """Fill in file/line on graph-rule findings from the probe facts
+    (behaviour def sites via fn.__code__; class sites for type-level
+    findings). Findings that already carry a location keep it."""
+    out = []
+    for f in findings:
+        if f.file is None:
+            file = line = None
+            bf = graph.nodes.get((f.type_name, f.behaviour))
+            if f.behaviour is not None and bf is not None:
+                file, line = bf.file, bf.line
+            else:
+                tf = graph.types.get(f.type_name)
+                if tf is not None:
+                    file, line = tf.file, tf.line
+            if file is not None:
+                f = dataclasses.replace(f, file=file, line=line)
+        out.append(f)
+    return out
+
+
 def run_rules(graph: FlowGraph,
               roots: Optional[List[Node]]) -> List[Finding]:
     findings: List[Finding] = []
@@ -278,9 +370,4 @@ def run_rules(graph: FlowGraph,
     findings += rule_r3_host_blobs(graph)
     findings += rule_r4_amplification(graph)
     findings += rule_r5_budgets(graph)
-    # Stable order: severity first, then rule/location — and dedupe.
-    rank = {s: i for i, s in enumerate(SEVERITIES)}
-    uniq = sorted(set(findings),
-                  key=lambda f: (rank[f.severity], f.rule, f.type_name,
-                                 f.behaviour or "", f.message))
-    return uniq
+    return sort_findings(attach_locations(findings, graph))

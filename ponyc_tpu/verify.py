@@ -20,6 +20,7 @@ verifying a program costs milliseconds.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -105,6 +106,24 @@ class Effects:
 class VerifyError(TypeError):
     """A behaviour violates its type's declared budgets (≙ the verify
     pass rejecting a method body, verify/fun.c)."""
+
+
+def behaviour_location(bdef: BehaviourDef
+                       ) -> Tuple[Optional[str], Optional[int]]:
+    """(source file, first line) of a behaviour's definition, where
+    derivable — captured at decoration time (api.BehaviourDef) from
+    the function's __code__, so lint findings and verify failures can
+    point at real source. (None, None) for functions without source
+    (exec'd strings, builtins)."""
+    file = getattr(bdef, "source_file", None)
+    line = getattr(bdef, "source_line", None)
+    if file is None:
+        code = getattr(bdef.fn, "__code__", None)
+        file = getattr(code, "co_filename", None)
+        line = getattr(code, "co_firstlineno", None)
+    if file is not None and not os.path.exists(file):
+        return None, None
+    return file, line
 
 
 class _ProbeContext(Context):

@@ -1,0 +1,120 @@
+"""Deliberately-broken behaviour bodies — the R6–R9 fixture corpus.
+
+This file DOES NOT IMPORT (the first import below names a module that
+does not exist): it exists to prove the body analyzer is pure AST —
+`check_path` must produce every seeded finding anyway. Each defect
+line carries a `MARK:<id>` comment; tests/test_bodycheck.py asserts
+the exact rule id + line number for every mark.
+"""
+
+import a_module_that_does_not_exist_anywhere  # noqa: F401
+
+from ponyc_tpu import Blob, I32, Iso, Ref, Val, actor, behaviour
+
+SEEN = []          # module-level mutable: closure-capture bait
+
+
+@actor
+class Peer:
+    x: I32
+
+    @behaviour
+    def take(self, st, p: Iso):
+        return st
+
+
+@actor
+class Branchy:
+    out: Ref["Peer"]
+    count: I32
+
+    @behaviour
+    def go(self, st, v: I32):
+        if st["count"] > 0:                        # MARK:r6-if
+            return st
+        flag = v > 0 and st["count"] < 9           # MARK:r6-and
+        pick = 1 if v else 2                       # MARK:r6-ternary
+        ok = not (v > 0)                           # MARK:r6-not
+        band = 0 < v < 9                           # MARK:r6-chain
+        assert v >= 0                              # MARK:r6-assert
+        return {**st, "count": st["count"] + pick + ok + band + flag}
+
+
+@actor
+class Loopy:
+    out: Ref["Peer"]
+    n: I32
+
+    @behaviour
+    def emit(self, st, n: I32):
+        for i in range(n):                         # MARK:r6-for
+            self.send(st["out"], Peer.take, i)     # MARK:r7-for-send
+        return st
+
+    @behaviour
+    def spin(self, st, v: I32):
+        while v < 4:                               # MARK:r6-while
+            self.exit(0)                           # MARK:r7-while-exit
+            v = v + 1
+        return st
+
+    @behaviour
+    def drops(self, st, v: I32):                   # MARK:r7-falloff
+        self.send(st["out"], Peer.take, v)
+
+
+@actor
+class Keys:
+    total: I32
+    frozen: Val
+
+    @behaviour
+    def tally(self, st, v: I32):
+        acc = st["totl"] + v                       # MARK:r8-read-typo
+        return {**st, "tote": acc}                 # MARK:r8-write-typo
+
+    @behaviour
+    def freeze_write(self, st, v: I32):
+        return {**st, "frozen": v}                 # MARK:r8-val-write
+
+    @behaviour
+    def drop_mut(self, st, v: I32):
+        st["total"] = v                            # MARK:r8-mut-dropped
+        return {"total": v}
+
+    @behaviour
+    def narrow(self, st, v: I32):
+        return {"total": v}                        # MARK:r8-missing
+
+    @behaviour
+    def selfish(self, st, v: I32):
+        self.total = v                             # MARK:r8-self-attr
+        return st
+
+
+@actor
+class Impure:
+    out: Ref["Peer"]
+    rng: I32
+
+    @behaviour
+    def noisy(self, st, v: I32):
+        print("dispatching", v)                    # MARK:r9-print
+        import numpy as np
+        r = np.random.randint(9)                   # MARK:r9-nprandom
+        import time
+        t = time.time()                            # MARK:r9-time
+        SEEN.append(v)                             # MARK:r9-capture
+        return {**st, "rng": st["rng"] + r + int(t)}
+
+    @behaviour
+    def twice(self, st, p: Iso):
+        self.send(st["out"], Peer.take, p)
+        self.send(st["out"], Peer.take, p)         # MARK:r9-move
+        return st
+
+    @behaviour
+    def freed(self, st, b: Blob):
+        self.blob_free(b)
+        ln = self.blob_length(b)                   # MARK:r9-free-use
+        return {**st, "rng": ln}

@@ -1,0 +1,52 @@
+"""The same defect shapes as broken_bodies.py, silenced through every
+suppression channel — type-level LINT_IGNORE, behaviour-level
+@behaviour(lint_ignore=...), and trailing line comments. check_path
+must report ZERO findings here (tests/test_bodycheck.py)."""
+
+import a_module_that_does_not_exist_anywhere  # noqa: F401
+
+from ponyc_tpu import I32, Ref, actor, behaviour
+
+
+@actor
+class Sink:
+    x: I32
+
+    @behaviour
+    def put(self, st, v: I32):
+        return {**st, "x": v}
+
+
+@actor
+class TypeMuted:
+    out: Ref["Sink"]
+    LINT_IGNORE = ("R6",)
+
+    @behaviour
+    def go(self, st, v: I32):
+        if v > 0:
+            self.send(st["out"], Sink.put, v)
+        return st
+
+
+@actor
+class BehaviourMuted:
+    out: Ref["Sink"]
+
+    @behaviour(lint_ignore=("R6",))
+    def go(self, st, v: I32):
+        if v > 0:
+            self.send(st["out"], Sink.put, v)
+        return st
+
+
+@actor
+class LineMuted:
+    out: Ref["Sink"]
+
+    @behaviour
+    def go(self, st, v: I32):
+        if v > 0:                      # lint: ignore[R6]
+            self.send(st["out"], Sink.put, v)
+        print("traced once")           # lint: ignore
+        return st

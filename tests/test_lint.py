@@ -484,10 +484,21 @@ def test_finding_formats_are_stable():
     f = Finding("R2", "error", "A", "go", "boom")
     assert str(f).startswith("R2 error")
     obj = json.loads(f.json_line())
+    # The stable schema: file/line are null when unknown (col stays
+    # internal — the github format uses it).
     assert obj == {"rule": "R2", "severity": "error", "type": "A",
-                   "behaviour": "go", "message": "boom"}
+                   "behaviour": "go", "message": "boom",
+                   "file": None, "line": None}
     assert format_findings([f]).count("\n") == 0
     assert json.loads(findings_to_json([f, f]).splitlines()[1])
+    # Located findings render compiler-style and annotate for GitHub.
+    g = Finding("R6", "warning", "A", "go", "boo%m", file="a/b.py",
+                line=7, col=3)
+    assert str(g).startswith("a/b.py:7: R6 warning")
+    assert json.loads(g.json_line())["line"] == 7
+    gh = g.github_line()
+    assert gh.startswith("::warning file=a/b.py,line=7,col=3,")
+    assert gh.endswith("::R6 A.go: boo%25m")
 
 
 # ---- the examples sweep (tier-1 regression net) -------------------------
