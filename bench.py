@@ -38,6 +38,12 @@ accelerator backends (CPU reload is unsound on jaxlib 0.4.37 —
 PROFILE.md §6), so a second identical run's warmup_s drops to
 executable-reload time.
 
+Every run also embeds a `telemetry` block: a headline-shaped pass at
+analysis=1 whose per-behaviour runs, queue-wait percentiles and GC
+stats (Runtime.profile(), the per-behaviour profiler of PROFILE.md §8)
+attribute the ticks, so the BENCH trajectory records where the time
+went, not just totals. The timed headline pass itself stays level 0.
+
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
                         [--delivery auto|plan|cosort] [--fused auto|on|off]
 Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
@@ -174,6 +180,50 @@ def bench_ubench(args):
         "delivery": rt.opts.delivery,
         "pallas": rt.opts.pallas,
         "pallas_fused": rt.opts.pallas_fused,
+    }
+
+
+def bench_telemetry(args, delivery="plan", fused=False):
+    """One headline-shaped pass at analysis=1: the per-behaviour
+    profiler (engine.profile_lanes / Runtime.profile()) attributes the
+    run so the BENCH json records WHERE the ticks went, not just
+    totals — per-behaviour runs, queue-wait percentiles, gc passes.
+    Runs after the timed pass on its own runtime (analysis is a
+    trace-time constant; the headline numbers stay level-0) at a
+    bounded world size so the extra jit never dominates a run."""
+    import jax.numpy as jnp
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ubench
+
+    actors = min(args.actors, 1 << 16)
+    ticks = 64
+    pings = args.pings
+    cap = ubench.cap_for_pings(pings, floor=args.cap)
+    opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
+                          msg_words=1, spill_cap=1024, inject_slots=8,
+                          delivery=delivery, pallas_fused=fused,
+                          analysis=1)
+    rt, ids = ubench.build(actors, opts, pings=pings)
+    ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)
+    state, aux, _k = rt._multi(rt.state, *rt._empty_inject,
+                               jnp.int32(ticks))
+    rt.state = state
+    rt.steps_run += ticks
+    prof = rt.profile()
+    return {
+        "actors": actors,
+        "ticks": ticks,
+        "analysis": 1,
+        "behaviours": prof["behaviours"],
+        "queue_wait_ticks": {
+            c: {"p50": v["queue_wait_p50"], "p99": v["queue_wait_p99"]}
+            for c, v in prof["cohorts"].items()},
+        "mute_ticks": {c: v["mute_ticks"]
+                       for c, v in prof["cohorts"].items()},
+        "gc_passes": prof["gc"]["passes"],
+        "attribution_ok": bool(
+            sum(b["runs"] for b in prof["behaviours"].values())
+            == prof["totals"]["processed"]),
     }
 
 
@@ -317,6 +367,14 @@ def main():
     ub = bench_ubench(args)
     lat = bench_latency(args, delivery=ub["delivery"],
                         fused=ub["pallas_fused"])
+    # Attribution pass (analysis=1): records per-behaviour runs +
+    # queue-wait percentiles so the perf trajectory carries attribution,
+    # not just totals. Never allowed to sink a headline run.
+    try:
+        telemetry = bench_telemetry(args, delivery=ub["delivery"],
+                                    fused=ub["pallas_fused"])
+    except Exception as e:                       # noqa: BLE001
+        telemetry = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -349,6 +407,10 @@ def main():
         # In-executable tick_ms per eligible variant + the decision —
         # every bench run IS the A/B record (PROFILE.md §6).
         "tuning": ub["tuning"],
+        # Per-behaviour attribution of a headline-shaped pass at
+        # analysis=1 (Runtime.profile(), PROFILE.md §8): the perf
+        # trajectory records WHERE the ticks went, not just totals.
+        "telemetry": telemetry,
     }
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error

@@ -7,7 +7,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from ponyc_tpu import I32, Ref, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor,  # noqa
+                       behaviour, options_from_env)
 from ponyc_tpu.platforms import auto_backend  # noqa: E402
 
 
@@ -42,8 +43,11 @@ class Reporter:
 def main():
     auto_backend()      # never hang on a wedged TPU plugin
     n, incs = 8, 100
-    rt = Runtime(RuntimeOptions(msg_words=2, inject_slots=256,
-                                batch=16))
+    # options_from_env so `python -m ponyc_tpu run examples/counter.py
+    # --ponyanalysis=2` (or any --pony* flag) reaches this runtime —
+    # the profiler smoke test drives the example exactly that way.
+    rt = Runtime(options_from_env(RuntimeOptions(
+        msg_words=2, inject_slots=256, batch=16)))
     rt.declare(Counter, n).declare(Reporter, 1).start()
     counters = rt.spawn_many(Counter, n)
     rep = rt.spawn(Reporter, expected=n * incs)
@@ -54,6 +58,7 @@ def main():
     for c in counters:
         rt.send(int(c), Counter.report, rep)
     code = rt.run()
+    rt.stop()     # analysis summary + writer-thread flush (≙ pony_stop)
     print("exit:", code)
     sys.exit(code)
 

@@ -36,6 +36,14 @@ Commands:
                               annotations. Exit codes as for verify
                               (1 = findings at error or warning
                               severity).
+  top [<analytics.csv>]       live terminal view of a running runtime's
+      [--interval S] [--once]  window stream (the level-2 CSV at
+                              RuntimeOptions.analysis_path): window
+                              throughput, queue pressure, GC stats,
+                              the per-behaviour run table and
+                              per-cohort queue-wait percentiles,
+                              refreshed every --interval seconds
+                              (--once renders a single frame).
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -327,6 +335,63 @@ def cmd_trace(argv) -> int:
     return 0
 
 
+def cmd_top(argv) -> int:
+    """Live profiler view (≙ watching the fork's analytics CSV, but
+    pre-digested like top(1)): tails the level-2 window CSV a running
+    runtime's writer thread appends to and reprints one frame per
+    interval — throughput, queue pressure, GC, per-behaviour runs,
+    per-cohort queue-wait percentiles (analysis.top_frame).
+
+    ponyc_tpu top [<analytics.csv>] [--interval S] [--once]"""
+    import time as _time
+    interval, once = 1.0, False
+    path = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--interval":
+            if i + 1 >= len(argv):
+                print("ponyc_tpu top: --interval needs seconds",
+                      file=sys.stderr)
+                return 2
+            try:
+                interval = float(argv[i + 1])
+            except ValueError:
+                print(f"ponyc_tpu top: bad interval {argv[i + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            i += 2
+            continue
+        if a == "--once":
+            once = True
+            i += 1
+            continue
+        if path is not None:
+            print("ponyc_tpu top: one CSV path only", file=sys.stderr)
+            return 2
+        path = a
+        i += 1
+    if path is None:
+        from .config import RuntimeOptions
+        path = RuntimeOptions().analysis_path
+    from .analysis import top_frame
+    try:
+        while True:
+            try:
+                frame = top_frame(path)
+            except FileNotFoundError:
+                frame = (f"ponyc_tpu top — {path}\n(waiting for a "
+                         "runtime with analysis>=2 to write windows)")
+            if once:
+                print(frame)
+                return 0
+            # Clear + home, then the frame: a plain-ANSI live view.
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            _time.sleep(max(0.05, interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -342,7 +407,7 @@ def cmd_version(_argv) -> int:
 
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
             "doc": cmd_doc, "verify": cmd_verify, "lint": cmd_lint,
-            "trace": cmd_trace, "version": cmd_version}
+            "trace": cmd_trace, "top": cmd_top, "version": cmd_version}
 
 
 def main(argv=None) -> int:

@@ -14,16 +14,27 @@ step when analysis >= 1) cost nothing observable.
   level 0 — off (default; the aux telemetry lanes compile to constants)
   level 1 — summary on run() end + SIGTERM/SIGUSR1 live-world dump
             (≙ sigintHandler analysis.c:55 + cycle.c:874-954 dump_views)
+            + the per-behaviour profiler matrix (Runtime.profile():
+            runs/deliveries/rejects per behaviour, queue-wait latency
+            histograms and mute-ticks per cohort, GC window stats —
+            ≙ the fork's per-actor records, computed in the jitted step
+            by engine.profile_lanes and fetched only at boundaries)
   level 2 — level 1 + one CSV row per quiesce window to
             RuntimeOptions.analysis_path via a writer thread
-            (≙ analysis.c:41-167 thread + CSV format)
+            (≙ analysis.c:41-167 thread + CSV format); the window CSV
+            carries the static columns below PLUS dynamic per-behaviour
+            `run:<Type.beh>` delta columns and per-cohort
+            `qw50:<Type>`/`qw99:<Type>` queue-wait percentiles
 
 Wire-up: ``analysis.attach(rt)`` (Runtime.run calls the hook
 automatically when opts.analysis >= 1 and nothing is attached yet).
+`python -m ponyc_tpu top <csv>` renders the window stream as a live
+terminal view (top_frame below).
 """
 
 from __future__ import annotations
 
+import math
 import os
 import queue
 import signal
@@ -38,6 +49,7 @@ CSV_COLUMNS = [
     "time_ms", "step", "processed", "delivered", "rejected", "badmsg",
     "deadletter", "mutes", "occ_sum", "occ_max", "muted_now",
     "overloaded_now", "host_processed", "inject_queue", "fast_queue",
+    "ev_dropped", "gc_runs", "gc_collected", "gc_swept",
     "rss_kb", "cpu_ms",
 ]
 
@@ -61,6 +73,23 @@ def _host_usage():
             else int(ru.ru_maxrss)
     return rss_kb, cpu_ms
 
+
+def hist_percentile(hist, q: float) -> int:
+    """Lower-bound tick value (2^k) of the q-quantile bucket of a
+    power-of-two queue-wait histogram (state.QW_BUCKETS buckets, bucket
+    k ↔ [2^k, 2^(k+1)) ticks); 0 when the histogram is empty."""
+    total = int(sum(int(v) for v in hist))
+    if total <= 0:
+        return 0
+    need = max(1, int(math.ceil(q * total)))
+    seen = 0
+    for k, v in enumerate(hist):
+        seen += int(v)
+        if seen >= need:
+            return 1 << k
+    return 1 << (len(hist) - 1)
+
+
 # Level-3 per-event lane (≙ analysis.h:16-31 event enum; the device
 # records transition events in a bounded ring, engine.py §5b).
 EVENT_NAMES = {1: "MUTE", 2: "UNMUTE", 3: "OVERLOAD", 4: "SPAWN",
@@ -80,10 +109,45 @@ class Analysis:
         self._stop = threading.Event()
         self._prev = {}
         self._saved_handlers = {}   # signum → handler to restore on close
+        self._warned_drops = False
+        # Window CSV schema: the static columns + one `run:` delta
+        # column per behaviour + per-device-cohort queue-wait
+        # percentiles (the per-behaviour profiler's window stream).
+        self.beh_names = [f"{b.actor_type.__name__}.{b.name}"
+                          for b in rt.program.behaviour_table]
+        self.dev_names = [c.atype.__name__
+                          for c in rt.program.device_cohorts]
+        self.columns = (CSV_COLUMNS
+                        + [f"run:{n}" for n in self.beh_names]
+                        + [c for n in self.dev_names
+                           for c in (f"qw50:{n}", f"qw99:{n}")])
+        from .runtime.state import QW_BUCKETS
+        self._prev_hist = np.zeros((len(self.dev_names), QW_BUCKETS),
+                                   np.int64)
         if self.level >= 2:
             self._writer = threading.Thread(target=self._write_loop,
                                             daemon=True)
             self._writer.start()
+
+    def _telemetry(self):
+        """One host read of the cumulative profiler matrix: returns
+        (runs [NB] incl. host-dispatch counts, hist [ND, QW_BUCKETS],
+        ev_dropped total, gc-collected total)."""
+        rt = self.rt
+        from .runtime.state import QW_BUCKETS
+        p = rt.program.shards
+        nb = len(rt.program.behaviour_table)
+        nd = len(rt.program.device_cohorts)
+        st = rt.state
+        runs = np.asarray(
+            rt._fetch(st.beh_runs), np.int64).reshape(p, nb).sum(0)
+        for g, n in rt._beh_host_runs.items():
+            runs[g] += n
+        hist = np.asarray(rt._fetch(st.qwait_hist), np.int64).reshape(
+            p, nd, QW_BUCKETS).sum(0)
+        dropped = int(np.asarray(rt._fetch(st.ev_dropped)).sum())
+        collected = int(np.asarray(rt._fetch(st.n_collected)).sum())
+        return runs, hist, dropped, collected
 
     # -- window hook (called by Runtime.run after each aux fetch) --
     def window(self, aux) -> None:
@@ -91,13 +155,24 @@ class Analysis:
             self._drain_events()
         if self.level < 2:
             return
-        # All counters ride the StepAux the run loop already fetched —
-        # no extra device round-trips on the hot path.
+        rt = self.rt
+        # Counters ride the StepAux the run loop already fetched; the
+        # profiler matrix is one extra small host read per window
+        # boundary (never per tick).
+        runs, hist, dropped, collected = self._telemetry()
+        if dropped and not self._warned_drops:
+            # One-time loudness (satellite fix): a too-small event ring
+            # used to lose level-3 trace events silently unless someone
+            # read dump().
+            self._warned_drops = True
+            print(f"ponyc_tpu analysis: device event ring dropped "
+                  f"{dropped} event(s) so far — raise "
+                  "RuntimeOptions.analysis_events", file=sys.stderr)
         row = [
             round((time.time() - self.t0) * 1e3, 3),
-            self.rt.steps_run,
-            self._delta("processed", self.rt.totals["processed"]),
-            self._delta("delivered", self.rt.totals["delivered"]),
+            rt.steps_run,
+            self._delta("processed", rt.totals["processed"]),
+            self._delta("delivered", rt.totals["delivered"]),
             self._delta("rejected", int(aux.n_rejected)),
             self._delta("badmsg", int(aux.n_badmsg)),
             self._delta("deadletter", int(aux.n_deadletter)),
@@ -105,11 +180,22 @@ class Analysis:
             int(aux.occ_sum), int(aux.occ_max),
             int(aux.n_muted_now), int(aux.n_overloaded_now),
             self._delta("host_processed",
-                        self.rt.totals.get("host_processed", 0)),
-            len(self.rt._inject_q),
-            len(self.rt._host_fast_q),
+                        rt.totals.get("host_processed", 0)),
+            len(rt._inject_q),
+            len(rt._host_fast_q),
+            self._delta("ev_dropped", dropped),
+            self._delta("gc_runs", rt.totals.get("gc_runs", 0)),
+            self._delta("gc_collected", collected),
+            self._delta("gc_swept", rt.totals.get("gc_swept_blobs", 0)),
         ]
         row.extend(_host_usage())
+        for g in range(runs.shape[0]):
+            row.append(self._delta(f"run:{g}", int(runs[g])))
+        for di in range(hist.shape[0]):
+            dh = hist[di] - self._prev_hist[di]
+            self._prev_hist[di] = hist[di]
+            row.append(hist_percentile(dh, 0.50))
+            row.append(hist_percentile(dh, 0.99))
         self._rows.put(row)
 
     def _delta(self, key, cur) -> int:
@@ -145,24 +231,49 @@ class Analysis:
 
     def _write_loop(self) -> None:
         opts = self.rt.opts
+        # Batched flushing (satellite fix): flush-per-row serialised the
+        # writer under level-3 event bursts. Rows now flush when the
+        # queue drains (a quiet stream stays promptly visible to `top`)
+        # or every opts.analysis_flush_ms while a burst is in flight;
+        # close() joins the thread and closing the files flushes the
+        # tail.
+        flush_s = max(0.0, getattr(opts, "analysis_flush_ms", 200) / 1e3)
         ev_f = open(opts.analysis_path + ".events.csv", "w") \
             if self.level >= 3 else None
+        dirty = []
+        last_flush = time.monotonic()
+
+        def _flush():
+            nonlocal last_flush
+            for fh in dirty:
+                fh.flush()
+            dirty.clear()
+            last_flush = time.monotonic()
+
         try:
             if ev_f is not None:
                 ev_f.write(",".join(EVENT_COLUMNS) + "\n")
             with open(opts.analysis_path, "w") as f:
-                f.write(",".join(CSV_COLUMNS) + "\n")
+                f.write(",".join(self.columns) + "\n")
                 while not (self._stop.is_set() and self._rows.empty()):
                     try:
                         row = self._rows.get(timeout=0.1)
                     except queue.Empty:
+                        if dirty:
+                            _flush()
                         continue
                     if isinstance(row, tuple) and row[0] == "ev":
-                        ev_f.write(",".join(str(x) for x in row[1]) + "\n")
-                        ev_f.flush()
+                        ev_f.write(",".join(str(x) for x in row[1])
+                                   + "\n")
+                        if ev_f not in dirty:
+                            dirty.append(ev_f)
                     else:
                         f.write(",".join(str(x) for x in row) + "\n")
-                        f.flush()
+                        if f not in dirty:
+                            dirty.append(f)
+                    if (self._rows.empty()
+                            or time.monotonic() - last_flush >= flush_s):
+                        _flush()
         finally:
             if ev_f is not None:
                 ev_f.close()
@@ -204,6 +315,29 @@ class Analysis:
         if bridge is not None:
             lines.append(f"asio_noisy={bridge.loop.noisy} "
                          f"asio_pending={bridge.loop.pending()}")
+        # The per-behaviour profiler (analysis >= 1): GC window stats,
+        # the hottest behaviours, and per-cohort queue-wait percentiles
+        # woven into the cohort rows below — the live-world analog of
+        # the fork's per-actor dump_views rows (cycle.c:874-954).
+        prof = None
+        if (rt.opts.analysis >= 1 and rt.state is not None
+                and rt.state.beh_runs.size):
+            try:
+                prof = rt.profile()
+            except Exception:           # mid-teardown: degrade to basics
+                prof = None
+        if prof is not None:
+            g = prof["gc"]
+            lines.append(f"gc passes={g['passes']} "
+                         f"collected={g['collected']} "
+                         f"blob_swept={g['blob_slots_reclaimed']} "
+                         f"aborted={g['aborted']}")
+            hot = sorted(prof["behaviours"].items(),
+                         key=lambda kv: -kv[1]["runs"])
+            for name, b in hot[:8]:
+                lines.append(f"  beh {name}: runs={b['runs']} "
+                             f"delivered={b['delivered']} "
+                             f"rejected={b['rejected']}")
         if rt.state is not None:
             occ = np.asarray(rt.state.tail) - np.asarray(rt.state.head)
             alive = np.asarray(rt.state.alive)
@@ -218,11 +352,18 @@ class Analysis:
                 cols = np.asarray(cohort.slot_to_gid(
                     np.arange(cohort.capacity)), np.int64)
                 co = occ[cols]
+                extra = ""
+                cinf = (prof or {}).get("cohorts", {}).get(
+                    cohort.atype.__name__)
+                if cinf is not None:
+                    extra = (f" qw_p50={cinf['queue_wait_p50']}"
+                             f" qw_p99={cinf['queue_wait_p99']}"
+                             f" mute_ticks={cinf['mute_ticks']}")
                 lines.append(
                     f"  cohort {cohort.atype.__name__}: "
                     f"cap={cohort.capacity} queued={int(co.sum())} "
                     f"max={int(co.max()) if co.size else 0} "
-                    f"muted={int(muted[cols].sum())}")
+                    f"muted={int(muted[cols].sum())}" + extra)
         text = "\n".join(lines)
         print(text, file=out or sys.stderr)
         return text
@@ -231,10 +372,26 @@ class Analysis:
                                            signal.SIGUSR1)) -> None:
         """Install dump-on-signal handlers (main thread only; ≙ the
         reference installing its SIGTERM handler when analysis > 0).
-        Previous handlers are restored by close()."""
+        SIGUSR1 (and any other signal passed) is dump-and-continue;
+        SIGTERM dumps, RESTORES the previous disposition and re-raises
+        so the process still terminates — the handler must observe the
+        world on the way out, not cancel the shutdown (the old lambda
+        swallowed SIGTERM forever). Previous handlers are restored by
+        close()."""
+        def _handler(signum, _frame):
+            self.dump()
+            if signum == signal.SIGTERM:
+                prev = self._saved_handlers.get(signum, signal.SIG_DFL)
+                try:
+                    signal.signal(signum, prev)
+                except (TypeError, ValueError):
+                    # prev came from outside Python (None) or we're off
+                    # the main thread: fall back to the default action.
+                    signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
         for s in signums:
             try:
-                prev = signal.signal(s, lambda *_: self.dump())
+                prev = signal.signal(s, _handler)
             except ValueError:   # not the main thread: skip
                 return
             self._saved_handlers.setdefault(s, prev)
@@ -275,11 +432,15 @@ def chrome_trace(csv_path: str, out_path: str,
     a timeline (examples/dtrace/telemetry.d — SURVEY §5's third tracing
     mechanism): the step-window CSV becomes counter tracks (queued
     messages, deepest mailbox, muted/overloaded actors, throughput per
-    window) and the level-3 event CSV becomes instant events
-    (MUTE/UNMUTE/OVERLOAD/SPAWN/DESTROY/ERROR, one thread lane per
-    class) — load the output in chrome://tracing or ui.perfetto.dev.
-    `events_path` defaults to `<csv_path>.events.csv` when that file
-    exists."""
+    window, anomalies), the dynamic per-behaviour `run:` columns become
+    one counter track per HOT behaviour (any nonzero window — the
+    per-op attribution timeline), the `qw50:`/`qw99:` columns one
+    queue-wait track per cohort, and the level-3 event CSV becomes
+    instant events (MUTE/UNMUTE/OVERLOAD/SPAWN/DESTROY/ERROR, one
+    thread lane per class) — load the output in chrome://tracing or
+    ui.perfetto.dev. Pre-profiler CSVs (no dynamic columns) still
+    convert. `events_path` defaults to `<csv_path>.events.csv` when
+    that file exists."""
     import csv as _csv
     import json
     import os
@@ -292,22 +453,37 @@ def chrome_trace(csv_path: str, out_path: str,
          "args": {"name": "step windows"}},
     ]
     with open(csv_path) as f:
-        for row in _csv.DictReader(f):
-            ts = float(row["time_ms"]) * 1e3          # µs
-            for track, cols in (
-                    ("queue", {"queued": "occ_sum",
-                               "deepest": "occ_max"}),
-                    ("actors", {"muted": "muted_now",
-                                "overloaded": "overloaded_now"}),
-                    ("window throughput", {"processed": "processed",
-                                           "delivered": "delivered"}),
-                    ("anomalies", {"rejected": "rejected",
-                                   "badmsg": "badmsg",
-                                   "deadletter": "deadletter"})):
-                out.append({"ph": "C", "pid": pid, "ts": ts,
-                            "name": track,
-                            "args": {k: int(row[c])
-                                     for k, c in cols.items()}})
+        rows = list(_csv.DictReader(f))
+    header = list(rows[0].keys()) if rows else []
+    run_cols = [c for c in header if c and c.startswith("run:")
+                and any(int(r[c] or 0) for r in rows)]
+    qw_cohorts = [c[5:] for c in header if c and c.startswith("qw50:")]
+    for row in rows:
+        ts = float(row["time_ms"]) * 1e3          # µs
+        for track, cols in (
+                ("queue", {"queued": "occ_sum",
+                           "deepest": "occ_max"}),
+                ("actors", {"muted": "muted_now",
+                            "overloaded": "overloaded_now"}),
+                ("window throughput", {"processed": "processed",
+                                       "delivered": "delivered"}),
+                ("anomalies", {"rejected": "rejected",
+                               "badmsg": "badmsg",
+                               "deadletter": "deadletter"})):
+            out.append({"ph": "C", "pid": pid, "ts": ts,
+                        "name": track,
+                        "args": {k: int(row[c])
+                                 for k, c in cols.items()}})
+        for c in run_cols:
+            out.append({"ph": "C", "pid": pid, "ts": ts,
+                        "name": f"behaviour {c[4:]}",
+                        "args": {"runs": int(row[c] or 0)}})
+        for cn in qw_cohorts:
+            out.append({"ph": "C", "pid": pid, "ts": ts,
+                        "name": f"queue-wait {cn}",
+                        "args": {"p50": int(row.get(f"qw50:{cn}") or 0),
+                                 "p99": int(row.get(f"qw99:{cn}")
+                                            or 0)}})
     if events_path is None:
         cand = csv_path + ".events.csv"
         events_path = cand if os.path.exists(cand) else None
@@ -330,3 +506,71 @@ def chrome_trace(csv_path: str, out_path: str,
         json.dump({"traceEvents": out,
                    "displayTimeUnit": "ms"}, f)
     return out_path
+
+
+def top_frame(csv_path: str) -> str:
+    """Render one frame of the live `top` view from the window CSV
+    stream (the writer thread's analysis_path file). Pure text — the
+    CLI (`python -m ponyc_tpu top`) clears the screen and reprints it
+    every interval; tests call it directly. ≙ watching the fork's
+    analytics CSV with `watch`, but pre-digested: window rates, queue
+    pressure, GC, the per-behaviour run table and per-cohort
+    queue-wait percentiles."""
+    import csv as _csv
+    with open(csv_path) as f:
+        rows = list(_csv.DictReader(f))
+    head = f"ponyc_tpu top — {csv_path}"
+    if not rows:
+        return head + "\n(no windows written yet)"
+
+    def iv(row, k):
+        v = row.get(k)
+        return int(float(v)) if v not in (None, "") else 0
+
+    last = rows[-1]
+    prev = rows[-2] if len(rows) > 1 else None
+    dt_ms = (float(last["time_ms"]) - float(prev["time_ms"])) if prev \
+        else float(last["time_ms"])
+    dt_s = max(dt_ms, 1e-3) / 1e3
+    lines = [head]
+    lines.append(f"step {last['step']}   "
+                 f"uptime {float(last['time_ms']) / 1e3:.1f}s   "
+                 f"windows {len(rows)}")
+    lines.append(f"window: processed {iv(last, 'processed')} "
+                 f"({iv(last, 'processed') / dt_s:,.0f}/s)  "
+                 f"delivered {iv(last, 'delivered')}  "
+                 f"rejected {iv(last, 'rejected')}  "
+                 f"deadletter {iv(last, 'deadletter')}")
+    lines.append(f"queue:  occ_sum {iv(last, 'occ_sum')}  "
+                 f"occ_max {iv(last, 'occ_max')}  "
+                 f"muted {iv(last, 'muted_now')}  "
+                 f"overloaded {iv(last, 'overloaded_now')}  "
+                 f"inject {iv(last, 'inject_queue')}  "
+                 f"fast {iv(last, 'fast_queue')}")
+    if "gc_runs" in last:
+        lines.append(
+            f"gc:     passes {sum(iv(r, 'gc_runs') for r in rows)}  "
+            f"collected {sum(iv(r, 'gc_collected') for r in rows)}  "
+            f"blob_swept {sum(iv(r, 'gc_swept') for r in rows)}   "
+            f"ev_dropped {sum(iv(r, 'ev_dropped') for r in rows)}")
+    beh_cols = [c for c in (rows[0].keys() or [])
+                if c and c.startswith("run:")]
+    if beh_cols:
+        totals = {c: sum(iv(r, c) for r in rows) for c in beh_cols}
+        lines.append("")
+        lines.append(f"{'behaviour':<36}{'win':>9}{'runs/s':>12}"
+                     f"{'total':>12}")
+        mx = max(iv(last, c) for c in beh_cols) or 1
+        for c in sorted(beh_cols, key=lambda c: -totals[c]):
+            win = iv(last, c)
+            bar = "#" * int(round(10 * win / mx))
+            lines.append(f"{c[4:]:<36}{win:>9}{win / dt_s:>12,.0f}"
+                         f"{totals[c]:>12}  {bar}")
+    qw_names = [c[5:] for c in (rows[0].keys() or [])
+                if c and c.startswith("qw50:")]
+    if qw_names:
+        lines.append("")
+        lines.append("queue-wait (ticks): " + "  ".join(
+            f"{n} p50={iv(last, 'qw50:' + n)} "
+            f"p99={iv(last, 'qw99:' + n)}" for n in qw_names))
+    return "\n".join(lines)

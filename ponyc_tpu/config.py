@@ -106,6 +106,10 @@ class RuntimeOptions:
     analysis_path: str = "/tmp/pony_tpu.analytics.csv"
     analysis_events: int = 4096    # device event-ring entries per shard
     #   (level 3); overflow between two drains drops and counts
+    analysis_flush_ms: int = 200   # writer-thread flush cadence: rows
+    #   batch and flush when the queue drains or this many ms pass,
+    #   whichever first (flush-per-row serialised the writer under
+    #   level-3 event bursts); 0 = flush after every batch
     pallas: Union[bool, str] = False   # route the dispatch mailbox drain
     #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
     #   XLA select-chain; interpret-mode on CPU. "auto" adds the kernel
@@ -215,6 +219,8 @@ class RuntimeOptions:
             raise ValueError("tuning_repeats must be >= 1")
         if self.tuning_ticks < 0:
             raise ValueError("tuning_ticks must be >= 0 (0 = auto)")
+        if self.analysis_flush_ms < 0:
+            raise ValueError("analysis_flush_ms must be >= 0")
         if self.blob_slots < 0 or self.blob_words < 0:
             raise ValueError("blob_slots/blob_words must be >= 0")
         if (self.blob_slots > 0) != (self.blob_words > 0):

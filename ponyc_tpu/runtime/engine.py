@@ -45,7 +45,7 @@ from ..ops import pack
 from ..ops.segment import compact_mask, counts_by_key, stable_sort_by
 from ..program import Cohort, Program
 from .delivery import (Entries, deliver, empty_mute_slots, mute_ref_slots)
-from .state import RtState, layout_sizes
+from .state import QW_BUCKETS, RtState, layout_sizes
 
 
 class StepAux(NamedTuple):
@@ -352,6 +352,110 @@ def _make_branch(bdef, msg_words: int, max_sends: int, field_dtypes,
                 blob_out)
 
     return branch
+
+
+def _qwait_bucket(delta):
+    """Power-of-two bucket index of a queue-wait delta (in ticks):
+    bucket k ↔ [2^k, 2^(k+1)) with deltas clipped to >= 1 and the last
+    bucket open-ended — floor(log2) spelled as QW_BUCKETS-1 vector
+    compares, which XLA fuses into the surrounding reductions."""
+    d = jnp.maximum(delta, 1)
+    b = jnp.zeros(d.shape, jnp.int32)
+    for k in range(1, QW_BUCKETS):
+        b = b + (d >= (1 << k)).astype(jnp.int32)
+    return b
+
+
+def profile_lanes(program: Program, opts: RuntimeOptions, st: RtState,
+                  tail0, res, drain_facts, muted2):
+    """The per-behaviour profiler lanes (≙ the fork's per-actor
+    --ponyanalysis records, analysis.h:16-31, re-based on the cohort —
+    the TPU unit of attribution). ONLY traced when opts.analysis >= 1:
+    the caller gates the call itself, so at level 0 none of this exists
+    in the jaxpr (the zero-cost test traps this function to prove it).
+
+    All facts are recomputed from the ring head/tail advances rather
+    than threaded out of the dispatch kernels, so ONE implementation
+    covers both dispatch formulations (the XLA scan and the fused
+    Pallas kernel) and their semantics cannot drift:
+
+      - beh_runs[g]       += messages of behaviour g dispatched this
+                             tick (ring slots [head0, head1) — the
+                             drained prefix, yield-shortened included);
+      - qwait_hist[c*QW+k] += dispatched messages of device cohort c
+                             whose delivery→dispatch wait fell in
+                             bucket k (deltas against the qwait_enq
+                             stamps written at delivery);
+      - coh_mute_ticks[c] += actors of device cohort c muted at end of
+                             tick (actor-ticks: the integral of
+                             muted_now);
+      - beh_delivered[g]  += messages of behaviour g accepted into
+                             mailboxes this tick (tail advance over the
+                             post-delivery tables; host cohorts count —
+                             the host drains those rows);
+      - beh_rejected[g]   += this tick's capacity rejections by target
+                             behaviour (the compacted spill's gid
+                             words — per-tick semantics match
+                             n_rejected: a parked message re-rejected
+                             next tick counts again);
+      - qwait_enq[type]    = enqueue-step stamps for freshly delivered
+                             ring slots (read back by the next ticks'
+                             deltas above).
+
+    `drain_facts` = [(cohort, head_before, head_after)] in
+    device-cohort order. Returns the six updated state fields."""
+    cap = opts.mailbox_cap
+    s_now = st.step_no[0]
+    beh_runs = st.beh_runs
+    beh_del = st.beh_delivered
+    beh_rej = st.beh_rejected
+    coh_mt = st.coh_mute_ticks
+    qw_hist = st.qwait_hist
+    qw_enq = dict(st.qwait_enq)
+    ci = jnp.arange(cap, dtype=jnp.int32)[:, None]   # ring-slot planes
+
+    def _count(mask):
+        return jnp.sum(mask.astype(jnp.int32))
+
+    # --- dispatch side: runs per behaviour + queue-wait histogram.
+    for di, (ch, head0, head1) in enumerate(drain_facts):
+        cname = ch.atype.__name__
+        n_con = head1 - head0
+        # Ring slot ci held a message drained this tick iff its
+        # monotonic count fell in [head0, head0 + n_con).
+        drained = ((ci - head0[None, :]) % cap) < n_con[None, :]
+        gid = st.buf[cname][:, 0, :]                 # [cap, rows]
+        for b in ch.behaviours:
+            beh_runs = beh_runs.at[b.global_id].add(
+                _count(drained & (gid == b.global_id)))
+        bidx = _qwait_bucket(s_now - qw_enq[cname])
+        for k in range(QW_BUCKETS):
+            qw_hist = qw_hist.at[di * QW_BUCKETS + k].add(
+                _count(drained & (bidx == k)))
+        coh_mt = coh_mt.at[di].add(
+            _count(muted2[ch.local_start:ch.local_stop]))
+
+    # --- delivery side: acceptances per behaviour + enqueue stamps.
+    for ch in program.cohorts:
+        cname = ch.atype.__name__
+        s0, s1 = ch.local_start, ch.local_stop
+        n_new = res.tail[s0:s1] - tail0[s0:s1]
+        fresh = ((ci - tail0[None, s0:s1]) % cap) < n_new[None, :]
+        gid = res.buf[cname][:, 0, :]
+        for b in ch.behaviours:
+            beh_del = beh_del.at[b.global_id].add(
+                _count(fresh & (gid == b.global_id)))
+        if cname in qw_enq:                          # device cohorts
+            qw_enq[cname] = jnp.where(fresh, s_now, qw_enq[cname])
+
+    # --- rejects by target behaviour (the compacted spill is exactly
+    # this tick's rejections, re-rejections of parked entries included).
+    sp_gid = res.spill.words[0]
+    sp_ok = res.spill.tgt >= 0
+    for g in range(len(program.behaviour_table)):
+        beh_rej = beh_rej.at[g].add(_count(sp_ok & (sp_gid == g)))
+
+    return beh_runs, beh_del, beh_rej, coh_mt, qw_hist, qw_enq
 
 
 def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
@@ -1303,6 +1407,8 @@ def build_step(program: Program, opts: RuntimeOptions):
         spawn_fail = st.spawn_fail[0]
         nproc_total = jnp.int32(0)
         nbad_total = jnp.int32(0)
+        drain_facts = []   # (cohort, head before, head after) — feeds
+        #   the profiler lanes (profile_lanes) when analysis >= 1
         for run_cohort, ch in dispatchers:
             s0, s1 = ch.local_start, ch.local_stop
             ids = base + s0 + jnp.arange(ch.local_capacity, dtype=jnp.int32)
@@ -1326,6 +1432,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                 nb_remote = nb_remote + blob_out[8]
             new_type_state[ch.atype.__name__] = stf
             head_segments.append(new_head_rows)
+            if opts.analysis >= 1:
+                drain_facts.append((ch, st.head[s0:s1], new_head_rows))
             out_entries.append(out)
             for t, cl in claims.items():
                 claim_lists[t].append(cl)
@@ -1601,6 +1709,20 @@ def build_step(program: Program, opts: RuntimeOptions):
                 jnp.any(masks), record,
                 lambda _: (ev_data, ev_count, ev_dropped), operand=None)
 
+        # --- 5c. per-behaviour profiler lanes (analysis level >= 1 only;
+        # the gate is PYTHON-level, so level 0 traces none of this —
+        # tests trap profile_lanes to assert exactly that).
+        if opts.analysis >= 1:
+            (beh_runs2, beh_del2, beh_rej2, coh_mt2, qw_hist2,
+             qw_enq2) = profile_lanes(program, opts, st, tail0, res,
+                                      drain_facts, muted2)
+        else:
+            beh_runs2, beh_del2, beh_rej2 = (st.beh_runs,
+                                             st.beh_delivered,
+                                             st.beh_rejected)
+            coh_mt2, qw_hist2 = st.coh_mute_ticks, st.qwait_hist
+            qw_enq2 = dict(st.qwait_enq)
+
         nrej_new = st.n_rejected[0] + res.n_rejected
         nbad_new = st.n_badmsg[0] + nbad_total
         ndl_new = st.n_deadletter[0] + res.n_deadletter
@@ -1717,6 +1839,9 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_errors=vec(st.n_errors[0] + n_errors),
             ev_data=ev_data, ev_count=vec(ev_count),
             ev_dropped=vec(ev_dropped),
+            beh_runs=beh_runs2, beh_delivered=beh_del2,
+            beh_rejected=beh_rej2, coh_mute_ticks=coh_mt2,
+            qwait_hist=qw_hist2, qwait_enq=qw_enq2,
             plan_key=res.plan_key, plan_perm=res.plan_perm,
             plan_bounds=res.plan_bounds,
             world_bits=vec(wb_new),

@@ -346,6 +346,15 @@ def build_gc(program: Program, opts: RuntimeOptions):
             n_errors=st.n_errors,
             ev_data=st.ev_data, ev_count=st.ev_count,
             ev_dropped=st.ev_dropped,
+            # Profiler lanes pass through untouched: collection frees
+            # actors, it dispatches nothing — the window stats the
+            # profiler reports about GC itself (passes run, actors
+            # collected, blob slots swept) ride this function's return
+            # values into Runtime.gc()'s host accounting.
+            beh_runs=st.beh_runs, beh_delivered=st.beh_delivered,
+            beh_rejected=st.beh_rejected,
+            coh_mute_ticks=st.coh_mute_ticks,
+            qwait_hist=st.qwait_hist, qwait_enq=st.qwait_enq,
             # Plan cache passes through: next step's key vector is
             # computed against the new `alive`, so deliveries to
             # collected actors invalidate it by comparison, not here.

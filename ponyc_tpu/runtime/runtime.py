@@ -196,6 +196,10 @@ class Runtime:
         self._bridge_pollers: List[Any] = []   # asio backends (bridge/)
         self.steps_run = 0
         self.totals = collections.Counter()    # lifetime stats (host ints)
+        # Host-cohort behaviour runs by global id (the host twin of the
+        # device beh_runs matrix — host behaviours dispatch here, so the
+        # device counters never see them; profile() merges both).
+        self._beh_host_runs: collections.Counter = collections.Counter()
         self._last_counters: Dict[str, int] = {}
         self._gc_fn = None
         self._freelist_key = None   # None = stale; "synced" = cache valid
@@ -482,9 +486,14 @@ class Runtime:
                     if v >= 0 and 0 <= slot < n_blob_total:
                         blob_roots[slot] = True
         before = self.counter("n_collected")
-        self.state, (n, converged, iters, _n_swept) = self._gc_fn(
+        self.state, (n, converged, iters, n_swept) = self._gc_fn(
             self.state, jnp.asarray(extra), jnp.asarray(blob_roots))
         self.totals["gc_runs"] += 1
+        # GC window stats for the profiler (analysis.window / profile()):
+        # passes run, trace iterations, blob slots reclaimed; actors
+        # collected ride the device n_collected counter.
+        self.totals["gc_iters"] += int(iters)
+        self.totals["gc_swept_blobs"] += int(n_swept)
         if not bool(converged):
             self.totals["gc_aborted"] += 1
         # Growth-triggered accounting reset (≙ heap.c's next_gc update
@@ -721,9 +730,19 @@ class Runtime:
         w1c = 1 + cohort.msg_words
         new_cbuf = self.state.buf[cname].at[slot, :, cols].set(
             jnp.asarray(words[:, :w1c]))
+        extra = {}
+        if cname in self.state.qwait_enq:
+            # Profiler enqueue stamp (analysis >= 1): bulk_send bypasses
+            # the in-step delivery that normally writes it, so stamp the
+            # current tick here — queue-wait deltas for host-seeded
+            # messages then measure from the seeding boundary.
+            extra["qwait_enq"] = {
+                **self.state.qwait_enq,
+                cname: self.state.qwait_enq[cname].at[slot, cols].set(
+                    jnp.int32(self.steps_run))}
         self.state = self._replace(
             buf={**self.state.buf, cname: new_cbuf},
-            tail=tail.at[targets].add(1))
+            tail=tail.at[targets].add(1), **extra)
 
     def _drain_inject(self):
         if not self._inject_q:
@@ -927,6 +946,8 @@ class Runtime:
             st2 = st
         self._host_state[aid] = st2 if st2 is not None else st
         self.totals["host_processed"] += 1
+        if self.opts.analysis >= 1:
+            self._beh_host_runs[int(gid)] += 1
         if ctx.exit_flag:
             self._exit_code = ctx.exit_code
             self._exit_requested = True
@@ -1262,6 +1283,91 @@ class Runtime:
         """Sum a per-shard runtime counter (n_processed, n_delivered,
         n_rejected, n_badmsg, n_deadletter, n_mutes) over the mesh."""
         return int(self._fetch(getattr(self.state, name)).sum())
+
+    def profile(self) -> Dict[str, Any]:
+        """Structured per-behaviour/per-cohort telemetry report — the
+        host face of the on-device profiler matrix (engine.profile_lanes;
+        ≙ reading back the fork's per-actor --ponyanalysis records).
+        Requires opts.analysis >= 1 (at level 0 the lanes compile away
+        and there is nothing to read). One small device fetch; call it
+        at window boundaries, not per tick.
+
+        Returns::
+
+            {"steps": int,
+             "behaviours": {"Type.beh": {"runs", "delivered",
+                                         "rejected"}},   # cumulative
+             "cohorts": {"Type": {"queue_wait_hist": [QW_BUCKETS ints],
+                                  "queue_wait_p50": int,   # ticks (2^k
+                                  "queue_wait_p99": int,   #  bucket lo)
+                                  "mute_ticks": int}},
+             "totals": {"processed", "delivered", "rejected", "badmsg",
+                        "deadletter", "mutes", "host_processed"},
+             "gc": {"passes", "collected", "blob_slots_reclaimed",
+                    "trace_iters", "aborted"}}
+
+        Device behaviours' runs sum to counter("n_processed") and
+        delivered sums to counter("n_delivered") for well-formed traffic
+        (badmsg deliveries are attributable to no behaviour); host
+        behaviours report their host-dispatch counts."""
+        if self.opts.analysis < 1:
+            raise RuntimeError(
+                "Runtime.profile() needs RuntimeOptions.analysis >= 1 "
+                "(the telemetry lanes compile to constants at level 0)")
+        if self.state is None:
+            raise RuntimeError("call start() first")
+        from ..analysis import hist_percentile
+        from .state import QW_BUCKETS
+        p = self.program.shards
+        nb = len(self.program.behaviour_table)
+        nd = len(self.program.device_cohorts)
+        runs = self._fetch(self.state.beh_runs).reshape(p, nb).sum(0)
+        deliv = self._fetch(
+            self.state.beh_delivered).reshape(p, nb).sum(0)
+        rej = self._fetch(self.state.beh_rejected).reshape(p, nb).sum(0)
+        mt = self._fetch(
+            self.state.coh_mute_ticks).reshape(p, nd).sum(0)
+        hist = self._fetch(self.state.qwait_hist).reshape(
+            p, nd, QW_BUCKETS).sum(0)
+        behaviours = {}
+        for g, bdef in enumerate(self.program.behaviour_table):
+            name = f"{bdef.actor_type.__name__}.{bdef.name}"
+            behaviours[name] = {
+                "runs": int(runs[g]) + self._beh_host_runs.get(g, 0),
+                "delivered": int(deliv[g]),
+                "rejected": int(rej[g]),
+            }
+        cohorts = {}
+        for di, ch in enumerate(self.program.device_cohorts):
+            h = [int(x) for x in hist[di]]
+            cohorts[ch.atype.__name__] = {
+                "queue_wait_hist": h,
+                "queue_wait_p50": hist_percentile(h, 0.50),
+                "queue_wait_p99": hist_percentile(h, 0.99),
+                "mute_ticks": int(mt[di]),
+            }
+        return {
+            "steps": self.steps_run,
+            "behaviours": behaviours,
+            "cohorts": cohorts,
+            "totals": {
+                "processed": self.counter("n_processed"),
+                "delivered": self.counter("n_delivered"),
+                "rejected": self.counter("n_rejected"),
+                "badmsg": self.counter("n_badmsg"),
+                "deadletter": self.counter("n_deadletter"),
+                "mutes": self.counter("n_mutes"),
+                "host_processed": self.totals.get("host_processed", 0),
+            },
+            "gc": {
+                "passes": self.totals.get("gc_runs", 0),
+                "collected": self.counter("n_collected"),
+                "blob_slots_reclaimed": self.totals.get(
+                    "gc_swept_blobs", 0),
+                "trace_iters": self.totals.get("gc_iters", 0),
+                "aborted": self.totals.get("gc_aborted", 0),
+            },
+        }
 
     def state_of(self, actor_id: int) -> Dict[str, Any]:
         cohort = self.program.cohort_of(actor_id)

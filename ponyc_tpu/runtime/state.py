@@ -51,6 +51,14 @@ import jax.numpy as jnp
 from ..config import RuntimeOptions
 from ..program import Program
 
+# Queue-wait histogram geometry (the profiler, engine.profile_lanes):
+# bucket k counts dispatched messages that waited [2^k, 2^(k+1)) ticks
+# between delivery (enqueue stamp) and dispatch; the last bucket is
+# open-ended (>= 2^(QW_BUCKETS-1)). Power-of-two buckets keep the
+# on-device update a handful of compares (≙ the DTrace scripts'
+# quantize() aggregations over the fork's USDT probes).
+QW_BUCKETS = 16
+
 
 def layout_sizes(program: Program, opts: RuntimeOptions):
     """Static per-shard sizes shared by build_step and init_state:
@@ -172,6 +180,33 @@ class RtState:
     ev_count: jnp.ndarray     # [P] int32 — valid entries since last drain
     ev_dropped: jnp.ndarray   # [P] int32 — lifetime overflow drops
 
+    # Per-behaviour profiler (analysis level >= 1; ≙ the fork's
+    # per-actor --ponyanalysis records, analysis.h:16-31 — per
+    # (cohort, behaviour) here because the cohort IS the TPU unit of
+    # attribution). All cumulative int32, indexed by GLOBAL behaviour
+    # id (which encodes the cohort: each type owns a contiguous gid
+    # range) or by device-cohort index. Zero-length when analysis < 1
+    # so every lane compiles away (engine.profile_lanes is never even
+    # traced at level 0 — the zero-cost-when-off discipline).
+    beh_runs: jnp.ndarray       # [P*NB] int32 — dispatches per behaviour
+    beh_delivered: jnp.ndarray  # [P*NB] int32 — mailbox acceptances per
+    #                               behaviour (host-cohort deliveries
+    #                               included: the host drains them)
+    beh_rejected: jnp.ndarray   # [P*NB] int32 — capacity rejections by
+    #                               target behaviour (per-tick semantics
+    #                               match n_rejected: a parked message
+    #                               re-rejected next tick counts again)
+    coh_mute_ticks: jnp.ndarray  # [P*ND] int32 — muted actor-ticks per
+    #                               device cohort (the integral of
+    #                               muted_now over ticks)
+    qwait_hist: jnp.ndarray     # [P*ND*QW_BUCKETS] int32 — queue-wait
+    #                               histogram per device cohort: bucket k
+    #                               = waited [2^k, 2^(k+1)) ticks from
+    #                               delivery to dispatch
+    qwait_enq: Dict[str, jnp.ndarray]  # {type: [cap, capacity]} int32 —
+    #                               enqueue-step stamp per ring slot
+    #                               (device cohorts; {} when analysis<1)
+
     # Cached delivery plan (see delivery.py): when consecutive ticks carry
     # the same (target, level) key vector — any topology-stable traffic —
     # the sort permutation and segment bounds are reused instead of
@@ -240,6 +275,9 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     s = opts.spill_cap * p
     _, _, n_entries = layout_sizes(program, opts)
     i32 = jnp.int32
+    # Profiler matrix sizes: zero when analysis < 1 (lanes compile away).
+    nb = len(program.behaviour_table) if opts.analysis >= 1 else 0
+    nd = len(program.device_cohorts) if opts.analysis >= 1 else 0
 
     type_state: Dict[str, Dict[str, Any]] = {}
     for cohort in program.cohorts:
@@ -296,6 +334,14 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
             i32),
         ev_count=jnp.zeros((p,), i32),
         ev_dropped=jnp.zeros((p,), i32),
+        beh_runs=jnp.zeros((p * nb,), i32),
+        beh_delivered=jnp.zeros((p * nb,), i32),
+        beh_rejected=jnp.zeros((p * nb,), i32),
+        coh_mute_ticks=jnp.zeros((p * nd,), i32),
+        qwait_hist=jnp.zeros((p * nd * QW_BUCKETS,), i32),
+        qwait_enq=({ch.atype.__name__: jnp.zeros((c, ch.capacity), i32)
+                    for ch in program.device_cohorts}
+                   if opts.analysis >= 1 else {}),
         plan_key=jnp.full((p * n_entries,), -1, i32),
         plan_perm=jnp.zeros((p * n_entries,), i32),
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),

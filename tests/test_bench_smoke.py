@@ -61,6 +61,19 @@ def test_bench_latency_uses_resolved_formulation(bench_mod):
     assert lat["p50_us"] > 0
 
 
+def test_bench_telemetry_block(bench_mod):
+    """The BENCH json's attribution block (per-behaviour profiler at
+    analysis=1): runs attribute exactly, queue-wait percentiles and gc
+    stats ride along."""
+    t = bench_mod.bench_telemetry(_args(), delivery="plan", fused=False)
+    assert t["attribution_ok"]
+    # actors × pings × ticks behaviours dispatched, all attributed
+    assert t["behaviours"]["Pinger.ping"]["runs"] \
+        == t["actors"] * 2 * t["ticks"]
+    assert t["queue_wait_ticks"]["Pinger"]["p50"] >= 1
+    assert "gc_passes" in t and "mute_ticks" in t
+
+
 def test_tristate_parsing(bench_mod):
     assert bench_mod.tristate("auto") == "auto"
     assert bench_mod.tristate("on") is True

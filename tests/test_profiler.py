@@ -1,0 +1,437 @@
+"""Per-behaviour profiler tests (≙ the fork's per-actor --ponyanalysis
+records, analysis.h:16-31): the on-device telemetry matrix
+(engine.profile_lanes), queue-wait latency histograms, GC window stats,
+Runtime.profile(), the window CSV's dynamic columns, per-behaviour
+chrome-trace tracks, the `top` view, and the zero-cost-at-level-0
+guarantee."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ponyc_tpu import (I32, Ref, Runtime, RuntimeOptions, actor,
+                       analysis, behaviour)
+from ponyc_tpu.models import ring
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _opts(**kw):
+    base = dict(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                spill_cap=64, inject_slots=8)
+    base.update(kw)
+    return RuntimeOptions(**base)
+
+
+# ---------------------------------------------------------------- matrix
+
+@actor
+class Worker:
+    done: I32
+
+    @behaviour
+    def work(self, st, v: I32):
+        return {**st, "done": st["done"] + v}
+
+    @behaviour
+    def reset(self, st, v: I32):
+        return {**st, "done": v}
+
+
+@actor
+class Driver:
+    out: Ref[Worker]
+    left: I32
+    MAX_SENDS = 2
+
+    @behaviour
+    def tick(self, st, _: I32):
+        self.send(st["out"], Worker.work, 1, when=st["left"] > 0)
+        self.send(self.actor_id, Driver.tick, 0, when=st["left"] > 1)
+        return {**st, "left": st["left"] - 1}
+
+
+def test_profile_sums_to_mesh_totals():
+    """Acceptance: per-(cohort, behaviour) runs/deliveries and the
+    queue-wait histograms sum to the mesh-wide n_processed/n_delivered
+    on a multi-behaviour, multi-cohort example."""
+    rt = Runtime(_opts(max_sends=2, msg_words=1, analysis=1,
+                       spill_cap=256, inject_slots=32))
+    rt.declare(Driver, 4).declare(Worker, 2).start()
+    ws = rt.spawn_many(Worker, 2)
+    ds = rt.spawn_many(Driver, 4, out=int(ws[0]), left=10)
+    rt.set_fields(Driver, ds[2:], out=int(ws[1]))
+    for w in ws:
+        rt.send(int(w), Worker.reset, 0)
+    for d in ds:
+        rt.send(int(d), Driver.tick, 0)
+    assert rt.run(max_steps=5000) == 0
+    prof = rt.profile()
+    beh = prof["behaviours"]
+    assert set(beh) == {"Worker.work", "Worker.reset", "Driver.tick"}
+    assert beh["Driver.tick"]["runs"] == 4 * 10
+    assert beh["Worker.work"]["runs"] == 4 * 10
+    assert beh["Worker.reset"]["runs"] == 2
+    assert sum(b["runs"] for b in beh.values()) \
+        == prof["totals"]["processed"] == rt.counter("n_processed")
+    assert sum(b["delivered"] for b in beh.values()) \
+        == prof["totals"]["delivered"] == rt.counter("n_delivered")
+    hist_total = sum(sum(c["queue_wait_hist"])
+                     for c in prof["cohorts"].values())
+    assert hist_total == prof["totals"]["processed"]
+    assert set(prof["cohorts"]) == {"Driver", "Worker"}
+
+
+def test_queue_wait_single_token_ring():
+    """A single-token ring dispatches every message exactly one tick
+    after delivery: the whole histogram lands in bucket 0 (wait 1)."""
+    rt, ids = ring.build(8, _opts(analysis=1))
+    rt.send(int(ids[0]), ring.RingNode.token, 50)
+    rt.run()
+    c = rt.profile()["cohorts"]["RingNode"]
+    assert c["queue_wait_hist"][0] == 50
+    assert sum(c["queue_wait_hist"][1:]) == 0
+    assert c["queue_wait_p50"] == 1 and c["queue_wait_p99"] == 1
+
+
+def test_backpressure_attribution():
+    """A flooded slow consumer shows up in the matrix: rejects blame
+    the flooded behaviour, mute-ticks blame the muted senders' cohort,
+    and the consumer's queue-wait spreads past bucket 0."""
+
+    @actor
+    class SlowP:
+        n: I32
+        BATCH = 1
+
+        @behaviour
+        def eat(self, st, v: I32):
+            return {**st, "n": st["n"] + 1}
+
+    @actor
+    class FastP:
+        out: Ref[SlowP]
+        left: I32
+        MAX_SENDS = 2
+
+        @behaviour
+        def go(self, st, _: I32):
+            self.send(st["out"], SlowP.eat, 1, when=st["left"] > 0)
+            self.send(self.actor_id, FastP.go, 0, when=st["left"] > 1)
+            return {**st, "left": st["left"] - 1}
+
+    rt = Runtime(RuntimeOptions(mailbox_cap=2, batch=1, msg_words=1,
+                                max_sends=2, spill_cap=512,
+                                inject_slots=16, analysis=1))
+    rt.declare(FastP, 12).declare(SlowP, 1).start()
+    s = rt.spawn(SlowP)
+    fs = rt.spawn_many(FastP, 12, out=s, left=30)
+    rt.bulk_send(fs, FastP.go, np.zeros(12, np.int64))
+    assert rt.run(max_steps=30_000) == 0
+    prof = rt.profile()
+    assert prof["behaviours"]["SlowP.eat"]["rejected"] > 0
+    assert prof["behaviours"]["FastP.go"]["rejected"] == 0
+    assert prof["cohorts"]["FastP"]["mute_ticks"] > 0
+    slow = prof["cohorts"]["SlowP"]
+    assert sum(slow["queue_wait_hist"][1:]) > 0, \
+        "a flooded mailbox must show waits > 1 tick"
+    assert slow["queue_wait_p99"] >= slow["queue_wait_p50"]
+    # rejected attribution matches the per-tick mesh counter semantics
+    assert sum(b["rejected"] for b in prof["behaviours"].values()) \
+        == rt.counter("n_rejected")
+
+
+def test_host_behaviour_runs_counted():
+    """Host-cohort behaviours dispatch host-side; profile() merges the
+    host dispatch counts into the same matrix."""
+
+    @actor
+    class DevSrc:
+        out: Ref
+        MAX_SENDS = 1
+
+        @behaviour
+        def emit(self, st, v: I32):
+            self.send(st["out"], HostSink.take, v)
+            return st
+
+    @actor
+    class HostSink:
+        HOST = True
+        seen: I32
+
+        @behaviour
+        def take(self, st, v: I32):
+            return {**st, "seen": st["seen"] + v}
+
+    rt = Runtime(_opts(msg_words=2, analysis=1))
+    rt.declare(DevSrc, 2).declare(HostSink, 1).start()
+    sink = rt.spawn(HostSink)
+    srcs = rt.spawn_many(DevSrc, 2, out=sink)
+    for s in srcs:
+        rt.send(int(s), DevSrc.emit, 3)
+    rt.run()
+    prof = rt.profile()
+    assert prof["behaviours"]["HostSink.take"]["runs"] == 2
+    assert prof["behaviours"]["DevSrc.emit"]["runs"] == 2
+    assert rt.state_of(sink)["seen"] == 6
+
+
+# -------------------------------------------------- zero-cost at level 0
+
+def test_level0_state_carries_no_lanes():
+    rt, _ = ring.build(8, _opts(analysis=0))
+    assert rt.state.beh_runs.size == 0
+    assert rt.state.beh_delivered.size == 0
+    assert rt.state.beh_rejected.size == 0
+    assert rt.state.coh_mute_ticks.size == 0
+    assert rt.state.qwait_hist.size == 0
+    assert rt.state.qwait_enq == {}
+    with pytest.raises(RuntimeError, match="analysis >= 1"):
+        rt.profile()
+
+
+def test_level0_lanes_compile_to_baseline(monkeypatch):
+    """Acceptance: at analysis=0 the step's jaxpr is IDENTICAL to a
+    baseline built with the profiler lanes physically unreachable
+    (profile_lanes trapped), proving level 0 traces zero telemetry ops;
+    at analysis>=1 the same trap fires, proving the helper is the only
+    source of the lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ponyc_tpu.program import Program
+    from ponyc_tpu.runtime import engine
+    from ponyc_tpu.runtime.state import init_state
+
+    def build(analysis):
+        opts = _opts(analysis=analysis, spill_cap=16, inject_slots=4)
+        prog = Program(opts)
+        prog.declare(ring.RingNode, 8)
+        prog.finalize()
+        st = init_state(prog, opts)
+        step = engine.build_step(prog, opts)
+        k = opts.inject_slots
+        inj_t = jnp.full((k,), -1, jnp.int32)
+        inj_w = jnp.zeros((1 + opts.msg_words, k), jnp.int32)
+        return str(jax.make_jaxpr(step)(st, inj_t, inj_w))
+
+    baseline = build(0)
+
+    def boom(*_a, **_k):
+        raise AssertionError("profiler lanes traced at analysis=0")
+
+    monkeypatch.setattr(engine, "profile_lanes", boom)
+    assert build(0) == baseline     # trap unreached, jaxpr bit-identical
+    with pytest.raises(AssertionError, match="lanes traced"):
+        build(1)                    # and it IS the only lane source
+
+
+# ------------------------------------------------------- GC window stats
+
+def test_gc_window_stats_thread_into_profile_and_csv(tmp_path):
+    @actor
+    class Kid:
+        x: I32
+
+        @behaviour
+        def init(self, st, v: I32):
+            return {**st, "x": v}
+
+    @actor
+    class Boss:
+        SPAWNS = {"Kid": 1}
+        made: I32
+
+        @behaviour
+        def make(self, st, v: I32):
+            self.spawn(Kid.init, v)
+            return {**st, "made": st["made"] + 1}
+
+    path = str(tmp_path / "gc.csv")
+    rt = Runtime(_opts(msg_words=2, analysis=2, analysis_path=path))
+    rt.declare(Boss, 1).declare(Kid, 8).start()
+    boss = rt.spawn(Boss)
+    for v in range(3):
+        rt.send(boss, Boss.make, v)
+    rt.run()
+    collected = rt.gc()     # spawned Kids are unreferenced → collected
+    assert collected == 3
+    # One more window so the CSV sees the gc deltas.
+    rt.send(boss, Boss.make, 9)
+    rt.run()
+    prof = rt.profile()
+    assert prof["gc"]["passes"] >= 1
+    assert prof["gc"]["collected"] >= 3
+    assert "blob_slots_reclaimed" in prof["gc"]
+    rt.stop()
+    lines = open(path).read().strip().split("\n")
+    header = lines[0].split(",")
+    for col in ("gc_runs", "gc_collected", "gc_swept", "ev_dropped"):
+        assert col in header
+    rows = [dict(zip(header, l.split(","))) for l in lines[1:]]
+    assert sum(int(r["gc_runs"]) for r in rows) >= 1
+    assert sum(int(r["gc_collected"]) for r in rows) >= 3
+
+
+# ------------------------------------------- chrome trace / CLI surfaces
+
+def test_chrome_trace_per_behaviour_tracks(tmp_path):
+    """Acceptance: chrome_trace output carries one counter track per
+    hot behaviour and validates against the Chrome-trace JSON schema
+    Perfetto loads."""
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(analysis=2, analysis_path=path))
+    rt.send(int(ids[0]), ring.RingNode.token, 40)
+    rt.run()
+    rt.stop()
+    out = str(tmp_path / "t.json")
+    analysis.chrome_trace(path, out)
+    doc = json.load(open(out))
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    for e in evs:        # minimal Perfetto/Chrome-trace event schema
+        assert e["ph"] in ("M", "C", "i")
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["name"], str)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], float)
+        if e["ph"] == "C":
+            assert all(isinstance(v, int) for v in e["args"].values())
+    beh = [e for e in evs
+           if e["ph"] == "C" and e["name"] == "behaviour RingNode.token"]
+    assert beh, "no per-behaviour counter track"
+    assert sum(e["args"]["runs"] for e in beh) == 40
+    qw = [e for e in evs
+          if e["ph"] == "C" and e["name"] == "queue-wait RingNode"]
+    assert qw and all(set(e["args"]) == {"p50", "p99"} for e in qw)
+
+
+def test_chrome_trace_pre_profiler_csv(tmp_path):
+    """Old CSVs (no dynamic columns) still convert — the trace CLI must
+    work on files written by earlier runtimes."""
+    path = str(tmp_path / "old.csv")
+    cols = ["time_ms", "step", "processed", "delivered", "rejected",
+            "badmsg", "deadletter", "mutes", "occ_sum", "occ_max",
+            "muted_now", "overloaded_now", "host_processed",
+            "inject_queue", "fast_queue", "rss_kb", "cpu_ms"]
+    with open(path, "w") as f:
+        f.write(",".join(cols) + "\n")
+        f.write(",".join(["1.0", "1"] + ["2"] * (len(cols) - 2)) + "\n")
+    out = str(tmp_path / "old.json")
+    analysis.chrome_trace(path, out)
+    doc = json.load(open(out))
+    assert any(e["name"] == "window throughput"
+               for e in doc["traceEvents"])
+
+
+def test_trace_cli(tmp_path):
+    """The `ponyc_tpu trace` subcommand: conversion + usage errors."""
+    from ponyc_tpu.__main__ import main as cli_main
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(analysis=2, analysis_path=path))
+    rt.send(int(ids[0]), ring.RingNode.token, 10)
+    rt.run()
+    rt.stop()
+    out = str(tmp_path / "cli.json")
+    assert cli_main(["trace", path, "-o", out]) == 0
+    assert json.load(open(out))["traceEvents"]
+    assert cli_main(["trace"]) == 2            # missing csv
+    assert cli_main(["trace", "-o"]) == 2      # -o without a path
+
+
+def test_top_frame_and_cli(tmp_path, capsys):
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(analysis=2, analysis_path=path))
+    rt.send(int(ids[0]), ring.RingNode.token, 30)
+    rt.run()
+    rt.stop()
+    frame = analysis.top_frame(path)
+    assert "RingNode.token" in frame
+    assert "queue-wait" in frame
+    assert "step " in frame and "gc:" in frame
+    from ponyc_tpu.__main__ import main as cli_main
+    assert cli_main(["top", path, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "RingNode.token" in out
+    # usage errors
+    assert cli_main(["top", "--interval"]) == 2
+    assert cli_main(["top", "--interval", "nope"]) == 2
+    assert cli_main(["top", "a.csv", "b.csv"]) == 2
+    # a missing file waits rather than crashing
+    assert cli_main(["top", str(tmp_path / "absent.csv"),
+                     "--once"]) == 0
+    assert "waiting" in capsys.readouterr().out
+
+
+def test_top_frame_empty_csv(tmp_path):
+    path = str(tmp_path / "empty.csv")
+    with open(path, "w") as f:
+        f.write(",".join(analysis.CSV_COLUMNS) + "\n")
+    assert "no windows" in analysis.top_frame(path)
+
+
+# ------------------------------------------------- signal / CLI smokes
+
+def test_sigterm_dumps_then_terminates(tmp_path):
+    """Satellite fix: after a level-1 dump on SIGTERM the handler
+    restores the default disposition and re-raises, so the process
+    actually dies of SIGTERM (the old lambda swallowed it forever)."""
+    code = f"""
+import os, signal, sys
+sys.path.insert(0, {ROOT!r})
+from ponyc_tpu.platforms import force_cpu
+force_cpu()
+from ponyc_tpu import RuntimeOptions, analysis
+from ponyc_tpu.models import ring
+rt, ids = ring.build(4, RuntimeOptions(
+    mailbox_cap=8, batch=1, max_sends=1, msg_words=1, analysis=1))
+rt.send(int(ids[0]), ring.RingNode.token, 5)
+rt.run()
+a = analysis.attach(rt)
+os.kill(os.getpid(), signal.SIGTERM)
+print("SURVIVED-SIGTERM")
+"""
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == -signal.SIGTERM, (p.returncode, p.stderr)
+    assert "ponyc_tpu analysis dump" in p.stderr
+    assert "SURVIVED-SIGTERM" not in p.stdout
+
+
+@pytest.mark.parametrize("flush_ms", [-1])
+def test_analysis_flush_ms_validated(flush_ms):
+    with pytest.raises(ValueError, match="analysis_flush_ms"):
+        RuntimeOptions(analysis_flush_ms=flush_ms)
+
+
+def test_example_smoke_analysis2(tmp_path):
+    """Tier-1 smoke: run a shipped example through the CLI at
+    analysis=2 and validate the window CSV schema end to end,
+    including the per-behaviour columns (satellite)."""
+    path = str(tmp_path / "counter.csv")
+    p = subprocess.run(
+        [sys.executable, "-m", "ponyc_tpu", "run",
+         os.path.join(ROOT, "examples", "counter.py"),
+         "--ponyanalysis=2", f"--ponyanalysis_path={path}"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    lines = open(path).read().strip().split("\n")
+    header = lines[0].split(",")
+    assert header[:len(analysis.CSV_COLUMNS)] == analysis.CSV_COLUMNS
+    for col in ("run:Counter.increment", "run:Counter.report",
+                "run:Reporter.result", "qw50:Counter", "qw99:Counter"):
+        assert col in header, col
+    rows = [dict(zip(header, l.split(","))) for l in lines[1:]]
+    # 8 counters × (100 increments sent as 25 messages of +4) = 200
+    assert sum(int(r["run:Counter.increment"]) for r in rows) == 200
+    assert sum(int(r["run:Counter.report"]) for r in rows) == 8
+    # the dump summary (level >= 1) ran on exit too
+    assert "analysis dump" in p.stderr
