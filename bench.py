@@ -227,6 +227,60 @@ def bench_telemetry(args, delivery="plan", fused=False):
     }
 
 
+def bench_runloop(args, delivery="plan", fused=False):
+    """Run-loop overhead study (PROFILE.md §9): drive the REAL
+    Runtime.run() — not rt._multi — over a seeded ubench world twice,
+    once with the forced synchronous fixed-window loop and once with
+    the pipelined adaptive loop, and record each mode's host_gap_us
+    (wall time the host left the device idle between windows) plus the
+    window-length histogram and controller trajectory. The pipelined/
+    sync ratio is THIS PR's acceptance number, re-measured by every
+    bench run so a regression shows up as a recorded value, not a
+    vibe. World size is bounded: the study measures loop overhead, not
+    throughput (the headline pass above owns that)."""
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ubench
+
+    actors = min(args.actors, 1 << 12)
+    steps = 1024
+    pings = args.pings
+    cap = ubench.cap_for_pings(pings, floor=args.cap)
+    out = {"actors": actors, "max_steps": steps}
+    for mode in ("sync", "pipelined"):
+        opts = RuntimeOptions(
+            mailbox_cap=cap, batch=pings, max_sends=1, msg_words=1,
+            spill_cap=1024, inject_slots=8, delivery=delivery,
+            pallas_fused=fused,
+            pipeline=(mode == "pipelined"),
+            quiesce_interval=("auto" if mode == "pipelined" else 64),
+            # The gap study must neither inherit nor publish converged
+            # windows — both modes start cold every run.
+            tuning_cache="off")
+        rt, ids = ubench.build(actors, opts, pings=pings)
+        ubench.seed_all(rt, ids, hops=1 << 30, pings=pings)
+        t0 = time.time()
+        rt.run(max_steps=steps)
+        elapsed = time.time() - t0
+        rl = rt.run_loop_stats()
+        out[mode] = {
+            "elapsed_s": round(elapsed, 3),
+            "steps": rt.steps_run,
+            "windows": rl["windows"],
+            "pipelined_dispatches": rl["pipelined_dispatches"],
+            "sync_dispatches": rl["sync_dispatches"],
+            "host_gap_us_mean": round(rl["host_gap_us_mean"], 1),
+            "host_gap_us_total": round(rl["host_gap_us_total"], 1),
+            "window_hist": rl["window_hist"],
+            "controller": rl["controller"],
+        }
+    s = out["sync"]["host_gap_us_mean"]
+    p = out["pipelined"]["host_gap_us_mean"]
+    # ∞-safe: a fully-pipelined run can expose literally zero gap.
+    out["host_gap_ratio"] = round(s / p, 2) if p > 0 else None
+    out["host_gap_2x_ok"] = bool(p * 2 <= s)
+    return out
+
+
 def bench_latency(args, delivery="plan", fused=False):
     """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
     one hop per tick. The headline number is the DEVICE-RESIDENT per-hop
@@ -375,6 +429,13 @@ def main():
                                     fused=ub["pallas_fused"])
     except Exception as e:                       # noqa: BLE001
         telemetry = {"error": str(e)}
+    # Run-loop overhead study (PROFILE.md §9): pipelined adaptive vs
+    # forced synchronous host_gap_us through the real run() loop.
+    try:
+        run_loop = bench_runloop(args, delivery=ub["delivery"],
+                                 fused=ub["pallas_fused"])
+    except Exception as e:                       # noqa: BLE001
+        run_loop = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -411,6 +472,10 @@ def main():
         # analysis=1 (Runtime.profile(), PROFILE.md §8): the perf
         # trajectory records WHERE the ticks went, not just totals.
         "telemetry": telemetry,
+        # host_gap_us: pipelined adaptive run loop vs the forced
+        # synchronous loop through the real Runtime.run() (PROFILE.md
+        # §9) — the standing record of this PR's win.
+        "run_loop": run_loop,
     }
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error

@@ -51,6 +51,11 @@ CSV_COLUMNS = [
     "overloaded_now", "host_processed", "inject_queue", "fast_queue",
     "ev_dropped", "gc_runs", "gc_collected", "gc_swept",
     "rss_kb", "cpu_ms",
+    # Adaptive run loop (PROFILE.md §9): ticks this window actually ran,
+    # the host-imposed device-idle gap before its dispatch (µs; 0 for
+    # windows dispatched behind an in-flight one), and the controller's
+    # next window length + state (grow/shrink/steady).
+    "window_ticks", "host_gap_us", "ctrl_window", "ctrl_state",
 ]
 
 
@@ -149,8 +154,10 @@ class Analysis:
         collected = int(np.asarray(rt._fetch(st.n_collected)).sum())
         return runs, hist, dropped, collected
 
-    # -- window hook (called by Runtime.run after each aux fetch) --
-    def window(self, aux) -> None:
+    # -- window hook (called by Runtime.run after each window retire;
+    # under the pipelined loop the writer runs while the next window is
+    # already in flight on device) --
+    def window(self, aux, ticks=None, gap_us=None) -> None:
         if self.level >= 3:
             self._drain_events()
         if self.level < 2:
@@ -189,6 +196,13 @@ class Analysis:
             self._delta("gc_swept", rt.totals.get("gc_swept_blobs", 0)),
         ]
         row.extend(_host_usage())
+        ctrl = getattr(rt, "_controller", None)
+        row.extend([
+            0 if ticks is None else int(ticks),
+            0 if gap_us is None else round(float(gap_us), 1),
+            0 if ctrl is None else int(ctrl.window),
+            "-" if ctrl is None else ctrl.state,
+        ])
         for g in range(runs.shape[0]):
             row.append(self._delta(f"run:{g}", int(runs[g])))
         for di in range(hist.shape[0]):
@@ -292,6 +306,18 @@ class Analysis:
                      f"fast_queue={len(rt._host_fast_q)}")
         rss_kb, cpu_ms = _host_usage()
         lines.append(f"host_rss_kb={rss_kb} host_cpu_ms={cpu_ms}")
+        # Adaptive run loop (PROFILE.md §9): live window length +
+        # controller state + cumulative host-gap exposure.
+        rl = rt.run_loop_stats() if hasattr(rt, "run_loop_stats") else None
+        if rl is not None and rl["controller"] is not None:
+            c = rl["controller"]
+            lines.append(
+                f"run_loop window={c['window']} ctrl={c['state']} "
+                f"[{c['lo']},{c['hi']}] grows={c['grows']} "
+                f"shrinks={c['shrinks']} windows={rl['windows']} "
+                f"pipelined={rl['pipelined_dispatches']}"
+                f"/{rl['pipelined_dispatches'] + rl['sync_dispatches']} "
+                f"host_gap_ms={rl['host_gap_us_total'] / 1e3:.2f}")
         if self.level >= 3 and rt.state is not None:
             lines.append(
                 f"events_pending={int(np.asarray(rt.state.ev_count).sum())} "
@@ -553,6 +579,14 @@ def top_frame(csv_path: str) -> str:
             f"collected {sum(iv(r, 'gc_collected') for r in rows)}  "
             f"blob_swept {sum(iv(r, 'gc_swept') for r in rows)}   "
             f"ev_dropped {sum(iv(r, 'ev_dropped') for r in rows)}")
+    if "window_ticks" in last:
+        gaps = [float(r.get("host_gap_us") or 0) for r in rows]
+        lines.append(
+            f"loop:   window {iv(last, 'window_ticks')} ticks  "
+            f"ctrl {iv(last, 'ctrl_window')}"
+            f" ({last.get('ctrl_state', '-')})  "
+            f"host_gap {gaps[-1]:.0f}us "
+            f"(mean {sum(gaps) / max(1, len(gaps)):.0f}us)")
     beh_cols = [c for c in (rows[0].keys() or [])
                 if c and c.startswith("run:")]
     if beh_cols:

@@ -69,11 +69,35 @@ class RuntimeOptions:
     #   never an early unmute)
 
     # --- lifecycle / quiescence (≙ scheduler.c:303-480 CNF/ACK) ---
-    quiesce_interval: int = 64     # max ticks fused into one device
-    #   dispatch (engine.build_multi_step); the window self-terminates on
-    #   host work / exit / fatal flags, so this bounds only how long the
-    #   device may run *uninterrupted* — raise to amortise dispatch
-    #   overhead, lower to tighten max_steps granularity
+    quiesce_interval: Union[int, str] = "auto"  # max ticks fused into
+    #   one device dispatch (engine.build_multi_step); the window
+    #   self-terminates on host work / exit / fatal flags, so this
+    #   bounds only how long the device may run *uninterrupted* — raise
+    #   to amortise dispatch overhead, lower to tighten max_steps
+    #   granularity. "auto" (default): the run loop sizes the window
+    #   ADAPTIVELY (runtime/controller.py — the fork's adaptive
+    #   scheduler sleeping): grow geometrically while windows run their
+    #   full budget with zero host attention, shrink multiplicatively
+    #   when host events cut windows short or the on-device queue-wait
+    #   p99 climbs, bounded by quiesce_interval_min/max; the initial
+    #   window resolves through the tuning cache (a previous run's
+    #   converged value). An explicit int fixes the window (no
+    #   adaptation) — the pre-adaptive behaviour.
+    quiesce_interval_min: int = 4  # adaptive window lower bound (the
+    #   shrink floor; also the smallest useful fused window — below
+    #   this, per-dispatch overhead dominates any workload)
+    quiesce_interval_max: int = 1024  # adaptive window upper bound:
+    #   caps host-event reaction latency (an in-flight window cannot be
+    #   interrupted) and max_steps overshoot granularity
+    pipeline: bool = True          # pipelined host bridge: dispatch
+    #   window k+1 behind in-flight window k (tick 0 gated ON DEVICE by
+    #   window k's aux — engine.build_multi_step_gated) and start a
+    #   non-blocking host copy of window k's control scalars at dispatch
+    #   time, so outbox drain / host behaviours / the analysis writer
+    #   overlap device compute instead of serialising against it. False
+    #   restores the fully synchronous fetch-then-dispatch loop (the
+    #   differential oracle: tests/test_run_loop.py proves the two agree
+    #   message-for-message)
     cd_interval: int = 128         # steps between cycle-detector scans
     #   (≙ --ponycdinterval default 100ms, start.c:206)
     gc_initial: int = 1 << 14      # host-heap bytes allocated since the
@@ -211,6 +235,16 @@ class RuntimeOptions:
             raise ValueError("batch must be >= 1")
         if self.delivery not in ("plan", "cosort", "auto"):
             raise ValueError("delivery must be 'plan', 'cosort' or 'auto'")
+        if isinstance(self.quiesce_interval, str):
+            if self.quiesce_interval != "auto":
+                raise ValueError(
+                    "quiesce_interval must be a positive int or 'auto'")
+        elif self.quiesce_interval < 1:
+            raise ValueError("quiesce_interval must be >= 1")
+        if self.quiesce_interval_min < 1 \
+                or self.quiesce_interval_max < self.quiesce_interval_min:
+            raise ValueError(
+                "need 1 <= quiesce_interval_min <= quiesce_interval_max")
         for name in ("pallas", "pallas_fused"):
             v = getattr(self, name)
             if not (v is True or v is False or v == "auto"):
@@ -248,6 +282,9 @@ _FLAG_TYPES = {f.name: f.type for f in dataclasses.fields(RuntimeOptions)}
 # coercion (everything else parses like a bool).
 _TRISTATE = ("pallas", "pallas_fused")
 
+# int-or-"auto" flags ("auto" survives coercion, anything else is int).
+_INT_OR_AUTO = ("quiesce_interval",)
+
 
 def _is_boolish(name: str) -> bool:
     return name in _TRISTATE or _FLAG_TYPES[name] in ("bool", bool)
@@ -258,6 +295,8 @@ def _coerce(name: str, raw: str):
     if name in _TRISTATE:
         return "auto" if raw.lower() == "auto" else (
             raw.lower() in ("1", "true", "yes", "on", ""))
+    if name in _INT_OR_AUTO:
+        return "auto" if raw.lower() == "auto" else int(raw)
     if ty in ("bool", bool):
         return raw.lower() in ("1", "true", "yes", "on", "")
     if ty in ("int", int, "Optional[int]", Optional[int]):

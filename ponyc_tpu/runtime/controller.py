@@ -1,0 +1,113 @@
+"""Adaptive quiesce-window controller — the TPU analog of the fork's
+adaptive scheduler sleeping (DIVERGENCE.md: schedulers size their
+idle/active windows to observed load instead of a fixed cadence;
+scheduler.c:918-935 scaling_sleep is the shrink side, the suspend
+threshold the grow side).
+
+Here the "window" is the tick budget of one fused device dispatch
+(engine.build_multi_step_gated): long windows amortise the per-dispatch
+host/RPC overhead (the round-2 60 ms/tick headline was almost all
+dispatch), short windows keep host reaction latency low. Neither is
+right statically — the right length is a function of observed load, so
+the run loop feeds every retired window's facts into this controller
+and dispatches the next window at whatever it says.
+
+Policy (MIMD — multiplicative increase, multiplicative decrease, the
+same shape as the fork's exponential sleep scaling):
+
+  - a window that ran its FULL budget with zero host attention is
+    evidence the device is busy and the host idle → GROW geometrically
+    (×2) toward `hi`;
+  - a window cut short by host attention (host-cohort mail, exit,
+    fatal flags) is evidence the host needs the boundary sooner →
+    SHRINK (×½) toward `lo`; likewise when the device's queue-wait p99
+    (StepAux.qw_p99, the PR 4 on-device histograms) climbs past the
+    window length — messages are waiting longer than a whole window,
+    so amortisation is no longer the bottleneck;
+  - a window that quiesced early (device went idle mid-window) is
+    evidence of neither → HOLD.
+
+The controller is a pure host object: `observe()` is deterministic in
+its arguments (tests replay recorded attention traces and assert the
+exact decision sequence), never touches the device, and `window` is
+always an int in [lo, hi]. With lo == hi it degrades to the fixed
+window of a concrete `quiesce_interval=N` — one code path either way.
+"""
+
+from __future__ import annotations
+
+GROW_FACTOR = 2.0
+SHRINK_FACTOR = 0.5
+# Consecutive full-budget quiet windows at the SAME length before the
+# controller reports "steady" (it keeps growing before that; at hi the
+# count runs against the clamp).
+STEADY_AFTER = 3
+
+
+class WindowController:
+    """Per-runtime adaptive window sizer. `state` is one of "grow",
+    "shrink", "steady" — surfaced by Runtime dump()/top for
+    observability, and "steady" additionally gates the tuning-cache
+    write-back of a converged window (tuning.store_quiesce_interval)."""
+
+    def __init__(self, initial: int, lo: int, hi: int,
+                 grow: float = GROW_FACTOR, shrink: float = SHRINK_FACTOR):
+        if lo < 1 or hi < lo:
+            raise ValueError(f"window bounds must satisfy 1 <= lo <= hi "
+                             f"(got lo={lo}, hi={hi})")
+        self.lo = int(lo)
+        self.hi = int(hi)
+        self.grow = float(grow)
+        self.shrink = float(shrink)
+        self.window = min(self.hi, max(self.lo, int(initial)))
+        self.state = "steady"
+        self.grows = 0          # lifetime decision counts (observability)
+        self.shrinks = 0
+        self.holds = 0
+        self._same = 0          # consecutive full-quiet windows here
+
+    def clamp(self, v: int) -> int:
+        return min(self.hi, max(self.lo, int(v)))
+
+    def observe(self, ran: int, budget: int, attention: bool,
+                qw_p99: int = 0) -> int:
+        """Feed one retired window's facts; returns the next window
+        budget. `ran` = ticks executed, `budget` = ticks granted,
+        `attention` = the window ended because the host had to act
+        (host-cohort mail / exit / fatal — NOT early quiescence),
+        `qw_p99` = the device queue-wait p99 in ticks (0 = unknown)."""
+        pressured = qw_p99 > self.window > self.lo
+        if attention or pressured:
+            nxt = self.clamp(int(self.window * self.shrink))
+            self.state = "shrink"
+            self.shrinks += 1
+            self._same = 0
+        elif ran >= budget and budget >= self.window:
+            # Full-budget exit with a quiet host: grow. (budget <
+            # window means the caller clamped the grant — e.g. a
+            # max_steps remainder — which says nothing about load.)
+            nxt = self.clamp(int(self.window * self.grow))
+            if nxt == self.window:
+                self._same += 1
+                self.state = "steady" if self._same >= STEADY_AFTER \
+                    else self.state
+                self.holds += 1
+            else:
+                self.state = "grow"
+                self.grows += 1
+                self._same = 0
+        else:
+            # Early quiescence (or a clamped grant): hold.
+            nxt = self.window
+            self.holds += 1
+            self._same += 1
+            if self._same >= STEADY_AFTER:
+                self.state = "steady"
+        self.window = nxt
+        return nxt
+
+    def snapshot(self) -> dict:
+        """Observable controller state (dump()/top/bench)."""
+        return {"window": self.window, "state": self.state,
+                "lo": self.lo, "hi": self.hi, "grows": self.grows,
+                "shrinks": self.shrinks, "holds": self.holds}

@@ -86,6 +86,13 @@ class StepAux(NamedTuple):
     n_badmsg: jnp.ndarray        # int32
     n_deadletter: jnp.ndarray    # int32
     n_mutes: jnp.ndarray         # int32
+    qw_p99: jnp.ndarray          # int32 — worst per-cohort queue-wait
+    #   p99 (ticks, 2^k bucket lower bound) of the CUMULATIVE on-device
+    #   histograms (profile_lanes), mesh max. Zero unless analysis >= 1.
+    #   The adaptive window controller (runtime/controller.py) shrinks
+    #   the quiesce window when this climbs past the window length —
+    #   long windows trade host-event latency for dispatch amortisation,
+    #   and this lane is the device's vote that the trade went bad.
 
 
 def _ring_take(buf_rows, slot):
@@ -1735,9 +1742,29 @@ def build_step(program: Program, opts: RuntimeOptions):
                 (occ_after > opts.overload_occ).astype(jnp.int32))
             nrej_all, nbad_all, ndl_all, nmut_all = (
                 nrej_new, nbad_new, ndl_new, nmut_new)
+            # Worst-cohort queue-wait p99 of the cumulative histograms —
+            # in-trace twin of analysis.hist_percentile (bucket k holds
+            # waits in [2^k, 2^(k+1)); the reported value is the lower
+            # bound of the first bucket whose cumulative count reaches
+            # ceil(0.99 * total)). Rides the aux so the host's window
+            # controller sees queue-wait pressure with no extra fetch.
+            nd_prof = qw_hist2.shape[0] // QW_BUCKETS
+            if nd_prof > 0:
+                h2 = qw_hist2.reshape(nd_prof, QW_BUCKETS)
+                tot = jnp.sum(h2, axis=1)
+                need = jnp.maximum(1, (tot * 99 + 99) // 100)
+                first = jnp.argmax(
+                    jnp.cumsum(h2, axis=1) >= need[:, None],
+                    axis=1).astype(jnp.int32)
+                qw_p99 = jnp.max(jnp.where(
+                    tot > 0, jnp.left_shift(jnp.int32(1), first),
+                    jnp.int32(0)))
+            else:
+                qw_p99 = jnp.int32(0)
         else:
             occ_sum = occ_max = n_muted_now = n_over_now = jnp.int32(0)
             nrej_all = nbad_all = ndl_all = nmut_all = jnp.int32(0)
+            qw_p99 = jnp.int32(0)
         local_pending = (jnp.any(occ_after[:fh] > 0)
                          | (res.spill_count > 0) | (rsp_count > 0))
         any_muted_local = jnp.any(muted2)
@@ -1786,11 +1813,12 @@ def build_step(program: Program, opts: RuntimeOptions):
                 nrej_all, nbad_all, ndl_all, nmut_all = (
                     summed[13], summed[14], summed[15], summed[16])
             maxed = lax.pmax(jnp.stack([
-                jnp.where(exit_f, exit_c, jnp.int32(-2**31)), occ_max]),
-                "actors")
+                jnp.where(exit_f, exit_c, jnp.int32(-2**31)), occ_max,
+                qw_p99]), "actors")
             exit_code_all = jnp.where(exit_any, maxed[0], exit_c)
             if opts.analysis >= 1:
                 occ_max = maxed[1]
+                qw_p99 = maxed[2]
         else:
             spawn_fail_any = spawn_fail
             device_pending = local_pending
@@ -1870,14 +1898,27 @@ def build_step(program: Program, opts: RuntimeOptions):
             n_muted_now=n_muted_now, n_overloaded_now=n_over_now,
             n_rejected=nrej_all, n_badmsg=nbad_all,
             n_deadletter=ndl_all, n_mutes=nmut_all,
+            qw_p99=qw_p99,
         )
         return st2, aux
 
     return local_step
 
 
-def build_multi_step(program: Program, opts: RuntimeOptions):
-    """Fuse up to `limit` scheduler ticks into ONE device dispatch.
+def aux_go(aux: StepAux):
+    """The window-continue vote: device work remains and no fact that
+    demands host attention (host mailboxes, exit, fatal flags) is up.
+    Shared by the in-window while condition and the tick-0 gate of the
+    pipelined dispatch (build_multi_step_gated) so the two can never
+    disagree about what "host attention" means."""
+    return (aux.device_pending & ~aux.host_pending & ~aux.exit_flag
+            & ~aux.spill_overflow & ~aux.spawn_fail
+            & ~aux.blob_fail & ~aux.blob_budget_fail)
+
+
+def build_multi_step_gated(program: Program, opts: RuntimeOptions):
+    """Fuse up to `limit` scheduler ticks into ONE device dispatch, with
+    tick 0 gated ON DEVICE by the PREVIOUS window's aux.
 
     ≙ the reference amortising scheduler-queue traffic by letting an actor
     drain up to `batch` messages per visit (actor.c:20): here the *host*
@@ -1890,18 +1931,33 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
     a behaviour exited, a fatal flag rose, or the device quiesced. Host
     reaction latency therefore stays one tick, exactly as unfused.
 
-    Injections land on the first tick only (the host refills next window).
+    The gate (the pipelined run loop, runtime.py): `prev_aux` is the aux
+    of the window dispatched just before this one, fed back WITHOUT a
+    host round-trip. Tick 0 runs iff `force` (the host KNOWS there is
+    work: a sync-point dispatch after host-side writes) or `prev_aux`
+    voted clean-busy (aux_go). Otherwise the whole window is an identity
+    pass returning `prev_aux` unchanged and ticks_run == 0 — so a window
+    speculatively dispatched behind an in-flight one can never advance
+    the world past an exit/fatal/host-attention boundary the host has
+    not yet observed, and a stale "quiet" vote never runs a tick. That
+    keeps the CNF/ACK quiescence semantics (scheduler.c:303-480) exact:
+    quiescence is only ever declared from an aux that no later tick has
+    invalidated.
+
+    Injections land on the first tick only (the host refills next
+    window); a gated-out window consumes none (ticks_run == 0 tells the
+    host to re-queue them).
     Returns (state, last_aux, ticks_run).
     """
     step = build_step(program, opts)
 
-    def multi(st: RtState, inject_tgt, inject_words, limit):
+    def multi(st: RtState, inject_tgt, inject_words, limit, force,
+              prev_aux: StepAux):
         def cond(carry):
             _st, aux, i = carry
-            go = (aux.device_pending & ~aux.host_pending & ~aux.exit_flag
-                  & ~aux.spill_overflow & ~aux.spawn_fail
-                  & ~aux.blob_fail & ~aux.blob_budget_fail)
-            return (i == 0) | ((i < limit) & go)
+            first = i == 0
+            return (first & (force | aux_go(aux))) | \
+                (~first & (i < limit) & aux_go(aux))
 
         def body(carry):
             s, _aux, i = carry
@@ -1912,8 +1968,21 @@ def build_multi_step(program: Program, opts: RuntimeOptions):
             return (s2, aux2, i + 1)
 
         stf, auxf, k = lax.while_loop(cond, body,
-                                      (st, zero_aux(), jnp.int32(0)))
+                                      (st, prev_aux, jnp.int32(0)))
         return stf, auxf, k
+
+    return multi
+
+
+def build_multi_step(program: Program, opts: RuntimeOptions):
+    """The ungated window: `build_multi_step_gated` with tick 0 forced
+    (the pre-pipelining signature — bench.py and the profiling harnesses
+    drive it directly; zero_aux as prev keeps the carry well-typed)."""
+    gated = build_multi_step_gated(program, opts)
+
+    def multi(st: RtState, inject_tgt, inject_words, limit):
+        return gated(st, inject_tgt, inject_words, limit,
+                     jnp.bool_(True), zero_aux())
 
     return multi
 
@@ -1932,7 +2001,7 @@ def zero_aux() -> StepAux:
         occ_sum=i32(0), occ_max=i32(0),
         n_muted_now=i32(0), n_overloaded_now=i32(0),
         n_rejected=i32(0), n_badmsg=i32(0),
-        n_deadletter=i32(0), n_mutes=i32(0))
+        n_deadletter=i32(0), n_mutes=i32(0), qw_p99=i32(0))
 
 
 def build_forced_window(program: Program, opts: RuntimeOptions):
@@ -1971,12 +2040,14 @@ def jit_forced_window(program: Program, opts: RuntimeOptions, mesh=None):
 
 
 def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
-                   n_extra: int):
+                   n_extra: int, extra_in=None):
     """Jit `fn(state, inject_tgt, inject_words, *extras) → (state, aux,
     *outs)` where len(outs) == n_extra; with a mesh, shard_map over the
     'actors' axis first. State is sharded and donated; injections, extras
     and aux are replicated (aux values are each tick's psum votes,
-    identical on every shard).
+    identical on every shard). `extra_in` names the extra INPUTS' spec
+    kinds — "repl" (scalar) or "aux" (a replicated StepAux pytree, the
+    gated window's fed-back prev_aux); defaults to n_extra scalars.
 
     ≙ ponyint_sched_start picking how many schedulers run
     (scheduler.c:1273-1309) — except "schedulers" are mesh shards and the
@@ -1991,10 +2062,14 @@ def _jit_over_mesh(fn, program: Program, opts: RuntimeOptions, mesh,
     repl = P()
     state_spec = state_partition_specs(program, opts)
     aux_spec = StepAux(*([repl] * len(StepAux._fields)))
+    if extra_in is None:
+        extra_in = ("repl",) * n_extra
+    in_extra = tuple(aux_spec if kind == "aux" else repl
+                     for kind in extra_in)
     from ..compat import shard_map
     mapped = shard_map(
         fn, mesh=mesh,
-        in_specs=(state_spec, repl, repl) + (repl,) * n_extra,
+        in_specs=(state_spec, repl, repl) + in_extra,
         out_specs=(state_spec, aux_spec) + (repl,) * n_extra)
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -2005,6 +2080,18 @@ def jit_multi_step(program: Program, opts: RuntimeOptions, mesh=None):
     step accounting are shard-uniform)."""
     return _jit_over_mesh(build_multi_step(program, opts), program, opts,
                           mesh, n_extra=1)
+
+
+def jit_multi_step_gated(program: Program, opts: RuntimeOptions,
+                         mesh=None):
+    """Jit the PIPELINED window (build_multi_step_gated): extra
+    replicated inputs (tick limit, force bit, previous aux — all
+    shard-uniform by construction), extra replicated output ticks_run.
+    The run loop feeds each window's aux straight into the next
+    dispatch, so the gate costs no host round-trip."""
+    return _jit_over_mesh(build_multi_step_gated(program, opts), program,
+                          opts, mesh, n_extra=1,
+                          extra_in=("repl", "repl", "aux"))
 
 
 def jit_step(program: Program, opts: RuntimeOptions, mesh=None):

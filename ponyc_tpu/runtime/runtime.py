@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import collections
 import os
+import signal
 import time
 from typing import Any, Dict, List, Optional
 
@@ -36,7 +37,12 @@ from ..errors import PonyError
 from ..ops import pack
 from ..program import Program
 from . import engine
+from .controller import WindowController
 from .state import RtState, init_state
+
+# Window-length histogram buckets (power-of-two, like state.QW_BUCKETS):
+# bucket k counts retired windows that ran [2^k, 2^(k+1)) ticks.
+WIN_BUCKETS = 16
 
 
 class SpillOverflowError(RuntimeError):
@@ -213,6 +219,27 @@ class Runtime:
         #   start() when any option is "auto" (tuning.resolve): source
         #   (cache/calibrated/default), per-variant tick_ms table,
         #   winner — bench.py publishes it as the A/B record
+        # ---- adaptive run loop (PROFILE.md §9) ----
+        self._controller: Optional[WindowController] = None  # window
+        #   sizer, created at start() (fixed lo==hi when
+        #   quiesce_interval is a concrete int)
+        self._qi_auto = False         # quiesce_interval was "auto"
+        self._qi_loaded = 0           # the initial window resolve() gave
+        self._state_epoch = 0         # monotonic state-write stamp: the
+        #   pipelined retire clears _device_dirty only when NO host
+        #   write landed since that window's dispatch (a write after
+        #   dispatch is invisible to the window's aux)
+        self._last_retire_t: Optional[float] = None
+        # Run-loop telemetry (run_loop_stats()): windows retired, how
+        #   many dispatches rode behind an in-flight window, cumulative
+        #   host-imposed device-idle gap, re-queued gated-out injects,
+        #   window-length histogram.
+        self._rl_windows = 0
+        self._rl_pipelined = 0
+        self._rl_synced = 0
+        self._rl_gap_ns = 0
+        self._rl_requeued = 0
+        self._win_hist = np.zeros((WIN_BUCKETS,), np.int64)
 
     # Any state assignment — including a driver pushing rt._step results
     # back, as bench.py does — conservatively invalidates the cached
@@ -231,6 +258,10 @@ class Runtime:
         # mailbox writes, restore(), flag flips) — the run loop's
         # host-only-boundary skip must not trust stale quiescence.
         self._device_dirty = True
+        # Write stamp for the pipelined run loop: a window's aux is
+        # authoritative at retire only if this counter still matches
+        # its at-dispatch value (no write raced the in-flight window).
+        self._state_epoch = getattr(self, "_state_epoch", 0) + 1
 
     # ---- construction (≙ pony_init) ----
     def declare(self, atype: ActorTypeMeta, capacity: int) -> "Runtime":
@@ -283,9 +314,38 @@ class Runtime:
             self.opts, self.tuning_record = tuning.resolve(
                 self.program, self.opts, self.mesh, self.state)
             self.program.opts = self.opts
+        # Adaptive quiesce window (runtime/controller.py): resolve the
+        # "auto" initial value through the tuning cache (a previous
+        # run's converged window for this layout), then hand the bounds
+        # to the controller. A concrete int pins lo == hi — the fixed
+        # pre-adaptive window through the same code path.
+        qi = self.opts.quiesce_interval
+        self._qi_auto = qi == "auto"
+        if self._qi_auto:
+            qi, qi_rec = tuning.resolve_quiesce_interval(
+                self.program, self.opts)
+            lo = self.opts.quiesce_interval_min
+            hi = self.opts.quiesce_interval_max
+            self.tuning_record = {**(self.tuning_record or {}),
+                                  "quiesce_interval": qi_rec}
+        else:
+            qi = max(1, int(qi))
+            lo = hi = qi
+        self._qi_loaded = qi
+        self._controller = WindowController(qi, lo, hi)
+        import dataclasses as _dc
+        self.opts = _dc.replace(self.opts, quiesce_interval=qi)
+        self.program.opts = self.opts
         self._step = engine.jit_step(self.program, self.opts, self.mesh)
         self._multi = engine.jit_multi_step(self.program, self.opts,
                                             self.mesh)
+        # The PIPELINED window (tick 0 gated on-device by the previous
+        # window's aux) — only the executable the run loop actually
+        # calls gets compiled (jit is lazy), so drivers that use
+        # self._multi directly (bench.py, profiling/) pay nothing here.
+        self._multi_g = engine.jit_multi_step_gated(
+            self.program, self.opts, self.mesh)
+        self._zero_aux = engine.zero_aux()
         w1 = 1 + self.opts.msg_words
         k = self.opts.inject_slots
         self._empty_inject = (jnp.full((k,), -1, jnp.int32),
@@ -745,8 +805,16 @@ class Runtime:
             tail=tail.at[targets].add(1), **extra)
 
     def _drain_inject(self):
+        tgt, words, _consumed = self._drain_inject_tracked()
+        return tgt, words
+
+    def _drain_inject_tracked(self):
+        """Like _drain_inject, but also returns the consumed (target,
+        words) pairs IN ORDER, so the pipelined run loop can re-queue
+        them verbatim when a gated-out window (ticks_run == 0) never
+        applied its injections."""
         if not self._inject_q:
-            return self._empty_inject
+            return (*self._empty_inject, [])
         k = self.opts.inject_slots
         w1 = 1 + self.opts.msg_words
         tgt = np.full((k,), -1, np.int32)
@@ -760,6 +828,7 @@ class Runtime:
         taken: Dict[int, int] = {}
         quota: Dict[int, int] = {}
         held: List[Any] = []
+        consumed: List[Any] = []
         i = 0
         while i < k and self._inject_q:
             t, w = self._inject_q.popleft()
@@ -777,11 +846,12 @@ class Runtime:
                 held.append((t, w))
                 continue
             taken[t] = c + 1
+            consumed.append((t, w))
             tgt[i] = t
             words[:, i] = w
             i += 1
         self._inject_q.extendleft(reversed(held))
-        return jnp.asarray(tgt), jnp.asarray(words)
+        return jnp.asarray(tgt), jnp.asarray(words), consumed
 
     # ---- asio bridge hooks (≙ asio/asio.c noisy accounting) ----
     def add_noisy(self):
@@ -986,6 +1056,167 @@ class Runtime:
         return True
 
     # ---- the run loop (≙ pony_start → scheduler run → quiescence) ----
+    #
+    # PIPELINED + ADAPTIVE since PROFILE.md §9: the loop keeps ONE
+    # window in flight and dispatches the next one BEHIND it before
+    # fetching its aux, so the host boundary (outbox drain, host
+    # behaviours, pollers, GC cadence, the analysis writer) overlaps
+    # device compute instead of serialising against it. Exactness is
+    # the device's job, not the host's: the speculative window's tick 0
+    # is gated ON DEVICE by the in-flight window's aux
+    # (engine.build_multi_step_gated), so when the one-window-stale aux
+    # turns out to demand host attention — host mail, exit, fatal
+    # flags, or quiescence — the speculated window is an identity pass
+    # (0 ticks, aux passed through, injections re-queued) and the loop
+    # falls back to the synchronous confirm dispatch. A "quiet" vote
+    # therefore never terminates the run unless no tick ran after it —
+    # the CNF/ACK semantics (scheduler.c:303-480) are unchanged and the
+    # differential/FIFO oracles hold message-for-message
+    # (tests/test_run_loop.py proves it against the forced synchronous
+    # loop). Window length adapts via self._controller
+    # (runtime/controller.py): grow on full-budget quiet windows,
+    # shrink on host-attention cuts and queue-wait p99 pressure.
+
+    def _defer_signals(self):
+        """Block SIGINT/SIGTERM delivery across the donation-critical
+        dispatch region: `self._multi_g` consumes (donates) the current
+        state buffers, so an interrupt raised between the call and the
+        state re-assignment would leave self.state pointing at deleted
+        buffers — the classic donated-buffer-reuse crash. Blocked
+        signals deliver the instant the mask is restored (a Ctrl-C
+        still lands within one dispatch call). Returns the previous
+        mask, or None where masking is unavailable."""
+        try:
+            return signal.pthread_sigmask(
+                signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
+        except (AttributeError, ValueError, OSError):
+            return None
+
+    def _restore_signals(self, prev) -> None:
+        if prev is not None:
+            signal.pthread_sigmask(signal.SIG_SETMASK, prev)
+
+    def _dispatch_window(self, budget: int, force: bool, prev_aux,
+                         pipelined: bool) -> Dict[str, Any]:
+        """Dispatch one gated window and start the non-blocking host
+        copy of its control scalars; returns the in-flight record for
+        _retire_window. `pipelined` windows ride behind an unretired
+        one (gate live, host exposed no device idle); sync-point
+        windows are accounted against the host gap — the wall time
+        from the previous retire to this dispatch's START (from then on
+        the window is the device's; the call itself may run the compute
+        inline on XLA:CPU's synchronous path, which must not read as
+        host-imposed idle), the quantity bench.py's host_gap_us
+        records."""
+        now = time.perf_counter()
+        if pipelined:
+            self._rl_pipelined += 1
+            gap_ns = 0      # dispatched while the previous window ran
+        else:
+            self._rl_synced += 1
+            gap_ns = 0 if self._last_retire_t is None else \
+                max(0, int((now - self._last_retire_t) * 1e9))
+        inj_t, inj_w, consumed = self._drain_inject_tracked()
+        mask = self._defer_signals()
+        try:
+            st2, aux, kdev = self._multi_g(
+                self.state, inj_t, inj_w, jnp.int32(max(1, budget)),
+                np.bool_(force), prev_aux)
+            self.state = st2
+            epoch = self._state_epoch
+        finally:
+            self._restore_signals(mask)
+        # Start the device→host DMA of the control scalars now; the
+        # retire's device_get then waits on data already in motion
+        # instead of issuing the request after the window completes.
+        for leaf in jax.tree.leaves((aux, kdev)):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:
+                pass
+        return {"aux": aux, "k": kdev, "budget": int(budget),
+                "consumed": consumed, "gap_ns": gap_ns, "epoch": epoch}
+
+    def _retire_window(self, win: Dict[str, Any]):
+        """Fetch an in-flight window's (ticks_run, aux) and fold it into
+        host accounting. A gated-out window (0 ticks) changed nothing:
+        its injections go back to the FRONT of the queue in order, and
+        no counters/controller/analysis state moves. Returns (k, aux as
+        host scalars)."""
+        k, a = jax.device_get((win["k"], win["aux"]))
+        self._last_retire_t = time.perf_counter()
+        k = int(k)
+        if k == 0:
+            if win["consumed"]:
+                self._inject_q.extendleft(reversed(win["consumed"]))
+                self._rl_requeued += len(win["consumed"])
+            return 0, a
+        # The window just observed (and advanced) true device state;
+        # its aux is authoritative for the quiescence-skip decision
+        # UNLESS a host-side write landed after its dispatch (the
+        # epoch moved) — such a write is invisible to this aux.
+        if self._state_epoch == win["epoch"]:
+            self._device_dirty = False
+        self.steps_run += k
+        if self.opts.debug_checks:
+            self.check_invariants()
+        # aux counters are cumulative int32; accumulate mod-2^32
+        # deltas so fetch cadence doesn't matter (< 2^31 events per
+        # window).
+        for key, cur in (("processed", int(a.n_processed) & 0xFFFFFFFF),
+                         ("delivered", int(a.n_delivered) & 0xFFFFFFFF)):
+            last = self._last_counters.get(key, 0)
+            self.totals[key] += (cur - last) & 0xFFFFFFFF
+            self._last_counters[key] = cur
+        self._rl_windows += 1
+        self._rl_gap_ns += win["gap_ns"]
+        self._win_hist[min(WIN_BUCKETS - 1,
+                           max(0, k.bit_length() - 1))] += 1
+        # Controller: a full-budget exit with no host attention grows
+        # the window; a host-attention cut (or queue-wait pressure via
+        # the qw_p99 aux lane) shrinks it; early quiescence holds.
+        attention = bool(a.host_pending) or bool(a.exit_flag) \
+            or bool(a.spill_overflow) or bool(a.spawn_fail) \
+            or bool(a.blob_fail) or bool(a.blob_budget_fail)
+        self._controller.observe(k, win["budget"], attention,
+                                 qw_p99=int(a.qw_p99))
+        if getattr(self, "_analysis", None) is not None:
+            self._analysis.window(a, ticks=k,
+                                  gap_us=win["gap_ns"] / 1e3)
+        return k, a
+
+    def _fatal_checks(self, a) -> None:
+        if bool(a.spill_overflow):
+            raise SpillOverflowError(
+                f"spill overflow at step {self.steps_run}")
+        if bool(a.spawn_fail):
+            raise SpawnCapacityError(
+                f"device spawn found no free slot by step "
+                f"{self.steps_run}")
+        if bool(a.blob_fail):
+            raise BlobCapacityError(
+                f"device blob_alloc found no free pool slot by step "
+                f"{self.steps_run} — the pool is exhausted: raise "
+                "RuntimeOptions.blob_slots, or free blobs "
+                "(ctx.blob_free) faster")
+        if bool(a.blob_budget_fail):
+            raise BlobCapacityError(
+                f"device blob_alloc exceeded its per-tick reservation "
+                f"budget by step {self.steps_run} — more allocating "
+                "dispatches than BLOB_DISPATCHES in one tick (free "
+                "pool slots may remain): raise the actor class's "
+                "BLOB_DISPATCHES (or lower its batch)")
+
+    @staticmethod
+    def _clean_busy(a) -> bool:
+        """Host-side twin of engine.aux_go: the retired aux votes
+        "device busy, zero host attention" — the only state worth
+        speculating a window behind."""
+        return (bool(a.device_pending) and not bool(a.host_pending)
+                and not bool(a.exit_flag) and not bool(a.spill_overflow)
+                and not bool(a.spawn_fail) and not bool(a.blob_fail)
+                and not bool(a.blob_budget_fail))
+
     def run(self, max_steps: Optional[int] = None) -> int:
         if self.state is None:
             raise RuntimeError("call start() first")
@@ -997,179 +1228,234 @@ class Runtime:
         # callback between runs) must be honoured, not discarded — the
         # flag is consumed at the break below, never cleared on entry.
         max_steps = max_steps or self.opts.max_steps
-        qi = max(1, self.opts.quiesce_interval)
+        ctrl = self._controller
+        pipelining = bool(self.opts.pipeline)
         idle_polls = 0
         steps_this_run = 0
         skipped_boundaries = 0
-        a = None          # last window's aux; None forces a first window
-        while True:
-            # A boundary where the device is provably quiescent and
-            # nothing needs injecting is HOST-ONLY: skip the device
-            # dispatch entirely (≙ idle schedulers staying asleep while
-            # the main-thread scheduler works, scheduler.c:527-746).
-            # Sound because with no injects and no pending device work,
-            # a window could neither dispatch nor deliver anything —
-            # device facts in `a` cannot change. Skipped boundaries
-            # count against max_steps so a runaway host program stays
-            # bounded exactly like a device one.
-            if (a is not None and not bool(a.device_pending)
-                    and not bool(a.host_pending)
-                    and not self._inject_q
-                    and not getattr(self, "_device_dirty", True)):
-                skipped_boundaries += 1
-                self._idle_boundaries += 1
-            else:
-                # One fused device dispatch advances up to `budget`
-                # ticks (engine.build_multi_step); the window
-                # self-terminates the tick host attention is needed, so
-                # host latency matches the old one-step-per-dispatch
-                # loop.
-                budget = qi
-                if max_steps is not None:
-                    budget = min(budget, max_steps - steps_this_run
-                                 - skipped_boundaries)
-                inj = self._drain_inject()
-                self.state, aux, kdev = self._multi(
-                    self.state, *inj, jnp.int32(max(1, budget)))
-                k, a = jax.device_get((kdev, aux))
-                # The window just observed (and advanced) true device
-                # state; until the next host-side state write, its aux
-                # is authoritative for the skip decision.
-                self._device_dirty = False
-                k = int(k)
-                self.steps_run += k
-                steps_this_run += k
-                if self.opts.debug_checks:
-                    self.check_invariants()
-                # aux counters are cumulative int32; accumulate
-                # mod-2^32 deltas so fetch cadence doesn't matter
-                # (< 2^31 events per window).
-                for key, cur in (("processed",
-                                  int(a.n_processed) & 0xFFFFFFFF),
-                                 ("delivered",
-                                  int(a.n_delivered) & 0xFFFFFFFF)):
-                    last = self._last_counters.get(key, 0)
-                    self.totals[key] += (cur - last) & 0xFFFFFFFF
-                    self._last_counters[key] = cur
-                if getattr(self, "_analysis", None) is not None:
-                    self._analysis.window(a)
-            if bool(a.spill_overflow):
-                raise SpillOverflowError(
-                    f"spill overflow at step {self.steps_run}")
-            if bool(a.spawn_fail):
-                raise SpawnCapacityError(
-                    f"device spawn found no free slot by step "
-                    f"{self.steps_run}")
-            if bool(a.blob_fail):
-                raise BlobCapacityError(
-                    f"device blob_alloc found no free pool slot by step "
-                    f"{self.steps_run} — the pool is exhausted: raise "
-                    "RuntimeOptions.blob_slots, or free blobs "
-                    "(ctx.blob_free) faster")
-            if bool(a.blob_budget_fail):
-                raise BlobCapacityError(
-                    f"device blob_alloc exceeded its per-tick reservation "
-                    f"budget by step {self.steps_run} — more allocating "
-                    "dispatches than BLOB_DISPATCHES in one tick (free "
-                    "pool slots may remain): raise the actor class's "
-                    "BLOB_DISPATCHES (or lower its batch)")
-            if bool(a.exit_flag):
-                self._exit_code = int(a.exit_code)
-                break
-            if bool(a.host_pending):
-                self._drain_host()
-            for p in self._bridge_pollers:
-                p.poll(self)
-            # Fast lane: host→host messages (including any the drains
-            # and pollers just produced) dispatch NOW, without waiting
-            # a device window per hop (≙ inject_main staying on the
-            # main-thread scheduler).
-            self._drain_host_fast(self.opts.host_fastpath_budget)
-            # Periodic collection (≙ the cycle detector triggered off the
-            # scheduler-0 idle path every --ponycdinterval,
-            # scheduler.c:976-989) — only when something can actually be
-            # garbage: a host ref was released or actors spawn on device.
-            # Host-heap allocation pressure schedules a collection EARLY
-            # (≙ the per-actor heap's growth-triggered GC, heap.c next_gc
-            # with --ponygcinitial/--ponygcfactor, start.c:204-209).
-            heap = getattr(self, "_heap", None)
-            heap_pressure = (heap is not None
-                             and heap.bytes_since_gc > self._next_gc)
-            # Cadence counts device steps + skipped host-only boundaries
-            # (steps_run freezes while boundaries are skipped; host-heavy
-            # phases must still collect periodically).
-            eff_step = self.steps_run + self._idle_boundaries
-            if (not self.opts.noblock
-                    and (self._ever_released
-                         or self.program.has_device_spawns)
-                    and (heap_pressure
-                         or (self.opts.cd_interval > 0
-                             and eff_step - self._last_gc_step
-                             >= self.opts.cd_interval))):
-                self._last_gc_step = eff_step
-                self.gc()
-            if self._exit_requested:
-                self._exit_requested = False    # consume the request
-                break
-            # A dirty device (host-side state write since the last
-            # window — e.g. bulk_send's direct mailbox writes from a
-            # host behaviour) is not provably quiet: stay busy so the
-            # next iteration runs a window before quiescence can hold.
-            busy = (bool(a.device_pending) or bool(a.host_pending)
-                    or bool(self._inject_q) or bool(self._host_fast_q)
-                    or getattr(self, "_device_dirty", False))
-            if not busy:
-                terminating = (self._noisy == 0
-                               and (not self._bridge_pollers
-                                    or idle_polls > 2))
-                if terminating:
-                    # Cleanup ticks ON THE TERMINATION PATH ONLY: the
-                    # unmute pass lags the drain that satisfies it by
-                    # one tick, so a program can quiesce with cosmetic
-                    # mute-flag residue. Bounded — pressure a host
-                    # never released legitimately holds mutes and must
-                    # not livelock termination; a merely-waiting
-                    # (noisy) program never pays these ticks.
-                    cleanup = 0
-                    while (bool(a.any_muted) and cleanup < 3
-                           and (max_steps is None
-                                or steps_this_run + skipped_boundaries
-                                < max_steps)):
-                        self.state, aux2, kdev = self._multi(
-                            self.state, *self._empty_inject, jnp.int32(1))
-                        a = jax.device_get(aux2)
-                        k2 = int(jax.device_get(kdev))
-                        self.steps_run += k2
-                        steps_this_run += k2
-                        cleanup += 1
-                    if getattr(self, "_analysis", None) is not None \
-                            and cleanup:
-                        # Drain the unmute trace events the cleanup
-                        # ticks generated (analysis level 3).
-                        self._analysis.window(a)
-                    break  # quiescent: terminate (≙ ACK'd CNF token)
-                idle_polls += 1
-                # Waiting on external events (timers/fds): BLOCK on the
-                # asio queue when a bridge is attached — the native
-                # epoll thread wakes us the instant an event lands
-                # (≙ a suspended scheduler woken by the ASIO thread,
-                # scheduler.c:1427-1476) — else back off exponentially
-                # (≙ the fork's scaling_sleep, scheduler.c:918-935).
-                # The cap only bounds non-asio pollers' cadence
-                # (process reaping, resolver completions).
-                waiter = next((p for p in self._bridge_pollers
-                               if hasattr(p, "wait")), None)
-                if waiter is not None:
-                    waiter.wait(0.02)
+        a = None          # newest RETIRED aux; None forces a first window
+        win = None        # the one in-flight (unretired) window
+        self._last_retire_t = None
+        try:
+            while True:
+                if win is None:
+                    # A boundary where the device is provably quiescent
+                    # and nothing needs injecting is HOST-ONLY: skip the
+                    # device dispatch entirely (≙ idle schedulers
+                    # staying asleep while the main-thread scheduler
+                    # works, scheduler.c:527-746). Sound because with no
+                    # injects and no pending device work, a window could
+                    # neither dispatch nor deliver anything — device
+                    # facts in `a` cannot change. Skipped boundaries
+                    # count against max_steps so a runaway host program
+                    # stays bounded exactly like a device one.
+                    if (a is not None and not bool(a.device_pending)
+                            and not bool(a.host_pending)
+                            and not self._inject_q
+                            and not getattr(self, "_device_dirty", True)):
+                        skipped_boundaries += 1
+                        self._idle_boundaries += 1
+                        # fall through to the host boundary below
+                    else:
+                        # Sync-point dispatch: the host knows everything
+                        # it needs (force=True runs tick 0 whatever the
+                        # carried aux says — host-side writes may have
+                        # created work the previous aux cannot see).
+                        budget = ctrl.window
+                        if max_steps is not None:
+                            budget = min(budget, max_steps - steps_this_run
+                                         - skipped_boundaries)
+                        win = self._dispatch_window(
+                            max(1, budget), force=True,
+                            prev_aux=a if a is not None else self._zero_aux,
+                            pipelined=False)
+                        continue    # top: pipeline behind it, then retire
                 else:
-                    time.sleep(min(0.002,
-                                   2e-5 * (1 << min(idle_polls, 7))))
-            else:
-                idle_polls = 0
-            if max_steps is not None \
-                    and steps_this_run + skipped_boundaries >= max_steps:
-                break
+                    # Pipeline refill: dispatch the NEXT window behind
+                    # the in-flight one BEFORE fetching its aux — the
+                    # device never idles across the boundary. Safe at
+                    # any speed: its tick 0 is gated on-device by the
+                    # in-flight aux, so it self-cancels if that window
+                    # ends needing host attention or quiet.
+                    spec = None
+                    if pipelining and a is not None and self._clean_busy(a):
+                        budget = ctrl.window
+                        if max_steps is not None:
+                            budget = min(budget,
+                                         max_steps - steps_this_run
+                                         - skipped_boundaries
+                                         - win["budget"])
+                        if budget >= 1:
+                            spec = self._dispatch_window(
+                                budget, force=False, prev_aux=win["aux"],
+                                pipelined=True)
+                    k, a = self._retire_window(win)
+                    steps_this_run += k
+                    win = spec
+                # ---- host boundary for `a` (overlaps `win`'s device
+                # execution when the pipeline kept one in flight) ----
+                self._fatal_checks(a)
+                if bool(a.exit_flag):
+                    self._exit_code = int(a.exit_code)
+                    break
+                if bool(a.host_pending):
+                    self._drain_host()
+                for p in self._bridge_pollers:
+                    p.poll(self)
+                # Fast lane: host→host messages (including any the drains
+                # and pollers just produced) dispatch NOW, without waiting
+                # a device window per hop (≙ inject_main staying on the
+                # main-thread scheduler).
+                self._drain_host_fast(self.opts.host_fastpath_budget)
+                # Periodic collection (≙ the cycle detector triggered off
+                # the scheduler-0 idle path every --ponycdinterval,
+                # scheduler.c:976-989) — only when something can actually
+                # be garbage: a host ref was released or actors spawn on
+                # device. Host-heap allocation pressure schedules a
+                # collection EARLY (≙ the per-actor heap's
+                # growth-triggered GC, heap.c next_gc with
+                # --ponygcinitial/--ponygcfactor, start.c:204-209).
+                heap = getattr(self, "_heap", None)
+                heap_pressure = (heap is not None
+                                 and heap.bytes_since_gc > self._next_gc)
+                # Cadence counts device steps + skipped host-only
+                # boundaries (steps_run freezes while boundaries are
+                # skipped; host-heavy phases must still collect
+                # periodically).
+                eff_step = self.steps_run + self._idle_boundaries
+                if (not self.opts.noblock
+                        and (self._ever_released
+                             or self.program.has_device_spawns)
+                        and (heap_pressure
+                             or (self.opts.cd_interval > 0
+                                 and eff_step - self._last_gc_step
+                                 >= self.opts.cd_interval))):
+                    self._last_gc_step = eff_step
+                    self.gc()
+                if self._exit_requested:
+                    self._exit_requested = False    # consume the request
+                    break
+                # A dirty device (host-side state write since the last
+                # window — e.g. bulk_send's direct mailbox writes from a
+                # host behaviour) is not provably quiet: stay busy so the
+                # next iteration runs a window before quiescence can hold.
+                busy = (bool(a.device_pending) or bool(a.host_pending)
+                        or bool(self._inject_q) or bool(self._host_fast_q)
+                        or getattr(self, "_device_dirty", False))
+                if not busy:
+                    if win is not None:
+                        # A speculated window may still be in flight; `a`
+                        # voted quiet, so its gate closed it to an
+                        # identity pass — retire (cheap) before deciding
+                        # termination from a fully-synced world.
+                        k2, a2 = self._retire_window(win)
+                        steps_this_run += k2
+                        win = None
+                        if k2 or self._inject_q:
+                            # Device disagreed (ticks ran), or the
+                            # gated-out window handed back injections:
+                            # not quiet after all.
+                            if k2:
+                                a = a2
+                            continue
+                    terminating = (self._noisy == 0
+                                   and (not self._bridge_pollers
+                                        or idle_polls > 2))
+                    if terminating:
+                        # Cleanup ticks ON THE TERMINATION PATH ONLY: the
+                        # unmute pass lags the drain that satisfies it by
+                        # one tick, so a program can quiesce with cosmetic
+                        # mute-flag residue. Bounded — pressure a host
+                        # never released legitimately holds mutes and must
+                        # not livelock termination; a merely-waiting
+                        # (noisy) program never pays these ticks. These
+                        # are the SYNCHRONOUS CONFIRM dispatches the
+                        # pipelined loop falls back to at quiescence.
+                        cleanup = 0
+                        while (bool(a.any_muted) and cleanup < 3
+                               and (max_steps is None
+                                    or steps_this_run + skipped_boundaries
+                                    < max_steps)):
+                            cw = self._dispatch_window(
+                                1, force=True, prev_aux=a, pipelined=False)
+                            k2, a = self._retire_window(cw)
+                            steps_this_run += k2
+                            cleanup += 1
+                        break  # quiescent: terminate (≙ ACK'd CNF token)
+                    idle_polls += 1
+                    # Waiting on external events (timers/fds): BLOCK on
+                    # the asio queue when a bridge is attached — the
+                    # native epoll thread wakes us the instant an event
+                    # lands (≙ a suspended scheduler woken by the ASIO
+                    # thread, scheduler.c:1427-1476) — else back off
+                    # exponentially (≙ the fork's scaling_sleep,
+                    # scheduler.c:918-935). The cap only bounds non-asio
+                    # pollers' cadence (process reaping, resolver
+                    # completions).
+                    waiter = next((p for p in self._bridge_pollers
+                                   if hasattr(p, "wait")), None)
+                    if waiter is not None:
+                        waiter.wait(0.02)
+                    else:
+                        time.sleep(min(0.002,
+                                       2e-5 * (1 << min(idle_polls, 7))))
+                else:
+                    idle_polls = 0
+                if max_steps is not None \
+                        and steps_this_run + skipped_boundaries >= max_steps:
+                    break
+        finally:
+            # Interrupt safety (KeyboardInterrupt/SIGTERM mid-pipeline,
+            # and every fatal raise above): an in-flight window's output
+            # IS self.state — sync it, fold its aux into the counters,
+            # and drain any host-cohort mail it surfaced, so a stopped
+            # run loses no host-outbox messages and the runtime stays
+            # consistent for a restart (no donated-buffer reuse).
+            import sys as _sys
+            if win is not None:
+                k2, a2 = self._retire_window(win)
+                steps_this_run += k2
+                if bool(a2.host_pending):
+                    self._drain_host()
+            if _sys.exc_info()[0] is not None:
+                # Interrupted between boundaries: host→host messages
+                # already queued on the fast lane would otherwise be
+                # stranded until the next run() — deliver them now
+                # (bounded by the normal per-boundary budget). Normal
+                # exits skip this: quiescent termination proves the
+                # lane empty, and an exit() break stops the world as
+                # the synchronous loop always has.
+                self._drain_host_fast(self.opts.host_fastpath_budget)
+        # Persist a converged adaptive window for warm starts (PR 1
+        # tuning-cache machinery): only a steady controller with real
+        # evidence writes, and only when the value actually moved.
+        if (self._qi_auto and ctrl.state == "steady"
+                and self._rl_windows >= 8
+                and ctrl.window != self._qi_loaded):
+            from .. import tuning
+            tuning.store_quiesce_interval(self.program, self.opts,
+                                          ctrl.window)
+            self._qi_loaded = ctrl.window
         return self._exit_code
+
+    def run_loop_stats(self) -> Dict[str, Any]:
+        """Observable run-loop telemetry (dump(), `top`, bench.py):
+        windows retired, pipelined vs sync-point dispatches, the
+        cumulative host-imposed device-idle gap, re-queued gated-out
+        injections, the window-length histogram (power-of-two buckets)
+        and the controller snapshot."""
+        n = max(1, self._rl_windows)
+        return {
+            "windows": self._rl_windows,
+            "pipelined_dispatches": self._rl_pipelined,
+            "sync_dispatches": self._rl_synced,
+            "host_gap_us_total": self._rl_gap_ns / 1e3,
+            "host_gap_us_mean": self._rl_gap_ns / 1e3 / n,
+            "injects_requeued": self._rl_requeued,
+            "window_hist": [int(x) for x in self._win_hist],
+            "controller": (self._controller.snapshot()
+                           if self._controller is not None else None),
+        }
 
     def request_exit(self, code: int = 0) -> None:
         """Ask the run loop to stop at the next host boundary (≙

@@ -426,6 +426,73 @@ def calibrate(program, opts: RuntimeOptions, mesh, state,
     return table, detail
 
 
+# ---------------------------------------------------------------------------
+# adaptive quiesce-window resolution (runtime/controller.py)
+#
+# quiesce_interval="auto" is resolved through the SAME on-disk cache
+# machinery as the formulation autos, but with its own record (keyed by
+# the layout key + a field marker + the clamp bounds): the stored value
+# is not a measured tick_ms winner, it is the window the adaptive
+# controller CONVERGED to on a previous run of this layout — the run
+# loop re-adapts from there instead of from a cold default, and a
+# steady workload's second run starts at its steady state.
+
+
+def quiesce_key(program, opts: RuntimeOptions) -> Dict[str, Any]:
+    key = tuning_key(program, opts)
+    key["field"] = "quiesce_interval"
+    key["bounds"] = [int(opts.quiesce_interval_min),
+                     int(opts.quiesce_interval_max)]
+    # The formulation autos' own resolution state is irrelevant to the
+    # window record (and would needlessly split the cache by it).
+    key.pop("auto", None)
+    key.pop("fixed", None)
+    return key
+
+
+# Cold-start initial window when the cache has no converged value: the
+# pre-adaptive fixed default, clamped into the configured bounds.
+DEFAULT_QUIESCE_INTERVAL = 64
+
+
+def resolve_quiesce_interval(program, opts: RuntimeOptions,
+                             ) -> Tuple[int, Dict[str, Any]]:
+    """Concrete initial window for quiesce_interval="auto": the cached
+    converged value for this layout, else the clamped default. Returns
+    (initial, record) — the record rides Runtime.tuning_record into the
+    bench JSON."""
+    lo, hi = opts.quiesce_interval_min, opts.quiesce_interval_max
+    clamp = lambda v: min(hi, max(lo, int(v)))         # noqa: E731
+    record: Dict[str, Any] = {"bounds": [lo, hi]}
+    cdir = tuning_cache_dir(opts)
+    key = quiesce_key(program, opts)
+    cached = load_cached(cdir, key)
+    if cached is not None and isinstance(
+            cached["chosen"].get("quiesce_interval"), int):
+        v = clamp(cached["chosen"]["quiesce_interval"])
+        record.update(source="cache", initial=v,
+                      cache_path=cache_path(cdir, key))
+        return v, record
+    v = clamp(DEFAULT_QUIESCE_INTERVAL)
+    record.update(source="default", initial=v)
+    return v, record
+
+
+def store_quiesce_interval(program, opts: RuntimeOptions,
+                           window: int) -> Optional[str]:
+    """Persist a converged adaptive window for this layout (called by
+    the run loop when the controller reaches steady state; best-effort
+    like every cache write)."""
+    cdir = tuning_cache_dir(opts)
+    if cdir is None:
+        return None
+    key = quiesce_key(program, opts)
+    return store_cached(cdir, key, {
+        "key": key, "chosen": {"quiesce_interval": int(window)},
+        "winner": f"window={int(window)}",
+        "written_unix": time.time()})
+
+
 def resolve(program, opts: RuntimeOptions, mesh, state,
             ) -> Tuple[RuntimeOptions, Dict[str, Any]]:
     """Turn "auto" option values into concrete ones: cache hit →
