@@ -46,6 +46,14 @@ went, not just totals. The timed headline pass itself stays level 0.
 
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
                         [--delivery auto|plan|cosort] [--fused auto|on|off]
+                        [--trace-smoke]
+
+--trace-smoke adds a `tracing` block: one sampled causal-tracing pass
+(analysis=3, trace_sample=1, PROFILE.md §10) reassembled and checked
+(spans_ok/span_count_ok — attribution_ok style). Every run records
+`backend_init_s`, and a failed TPU init — including --platform tpu,
+which now probes in a subprocess instead of hanging in-process — emits
+an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`).
 Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
        PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU /
        PONY_TPU_BENCH_DELIVERY / PONY_TPU_BENCH_FUSED override;
@@ -106,6 +114,23 @@ def probe_tpu(timeout_s: float, budget_s: float):
 def force_cpu():
     from ponyc_tpu.platforms import force_cpu as _force
     _force()
+
+
+def tpu_env_details():
+    """The probed-environment snapshot that rides every tpu_init_error
+    (satellite of ROADMAP item 2: benches r03–r05 regressed to CPU
+    with nothing in the JSON saying WHY the backend died — this block
+    makes the failure diagnosable from the BENCH record alone)."""
+    import importlib.util
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.startswith(("TPU", "JAX", "LIBTPU", "PJRT", "XLA"))
+           and "KEY" not in k and "TOKEN" not in k and "SECRET" not in k}
+    details = {"env": env,
+               "libtpu_importable":
+                   importlib.util.find_spec("libtpu") is not None}
+    for dev in ("/dev/accel0", "/dev/vfio"):
+        details[f"dev:{dev}"] = os.path.exists(dev)
+    return details
 
 
 def tristate(v):
@@ -281,6 +306,49 @@ def bench_runloop(args, delivery="plan", fused=False):
     return out
 
 
+def bench_trace_smoke(args, delivery="plan", fused=False):
+    """Causal-tracing smoke (PROFILE.md §10; --trace-smoke): one
+    sampled injection through a small ring at analysis=3 /
+    trace_sample=1, run to quiescence and reassembled — the BENCH
+    json's standing record (attribution_ok style) that trace
+    propagation, span-tick consistency and reassembly hold on THIS
+    platform. Bounded world, never allowed to sink a headline run
+    (main() guards with try/except)."""
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.models import ring
+    from ponyc_tpu.tracing import consistent
+
+    hops = 24
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=64, inject_slots=8,
+                          delivery=delivery, pallas_fused=fused,
+                          analysis=3, trace_sample=1,
+                          analysis_path="/tmp/pony_tpu.bench_trace.csv")
+    rt, ids = ring.build(64, opts)
+    t0 = time.time()
+    rt.send(int(ids[0]), ring.RingNode.token, hops)
+    rt.run()
+    elapsed = time.time() - t0
+    trees = rt.traces()
+    rt.stop()
+    spans = sum(t["n_spans"] for t in trees.values())
+    return {
+        "analysis": 3,
+        "trace_sample": 1,
+        "traces": len(trees),
+        "spans": spans,
+        "max_latency_ticks": max(
+            (t["latency"] for t in trees.values()), default=0),
+        "elapsed_s": round(elapsed, 3),
+        # The acceptance predicates: enq <= disp <= retire on every
+        # span with children nested under parents, and a single-token
+        # ring reassembling to exactly inject + one span per hop.
+        "spans_ok": bool(trees) and all(consistent(t)
+                                        for t in trees.values()),
+        "span_count_ok": bool(spans == hops + 1),
+    }
+
+
 def bench_latency(args, delivery="plan", fused=False):
     """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
     one hop per tick. The headline number is the DEVICE-RESIDENT per-hop
@@ -381,20 +449,32 @@ def main():
     ap.add_argument("--probe-budget", type=float,
                     default=float(os.environ.get(
                         "PONY_TPU_BENCH_PROBE_BUDGET", 900.0)))
+    ap.add_argument("--trace-smoke", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_TRACE_SMOKE", "0") == "1",
+                    help="run one sampled causal-tracing window "
+                    "(analysis=3, trace_sample=1) and embed a "
+                    "`tracing` block in the JSON (PROFILE.md §10)")
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
 
     allow_cpu = os.environ.get("PONY_TPU_BENCH_ALLOW_CPU", "1") != "0"
     tpu_error = None
+    # Backend init wall-time: probe + first jax.devices(), the number
+    # ROADMAP item 2's hang diagnosis needs in every BENCH record.
+    t_init = time.monotonic()
     if args.platform == "cpu":
         force_cpu()
     elif args.platform == "auto":
         plat, tpu_error = probe_tpu(args.probe_timeout, args.probe_budget)
         if plat is None:
             if not allow_cpu:
-                print(json.dumps({"error": "tpu_init_failed",
-                                  "detail": tpu_error}))
+                print(json.dumps({
+                    "error": "tpu_init_failed", "detail": tpu_error,
+                    "backend_init_s": round(
+                        time.monotonic() - t_init, 1),
+                    "tpu_env": tpu_env_details()}))
                 sys.exit(1)
             print("bench: TPU unavailable — falling back to CPU "
                   "(PONY_TPU_BENCH_ALLOW_CPU=0 to make this fatal). "
@@ -407,10 +487,23 @@ def main():
                 args.actors = 1 << 17
                 print("bench: CPU fallback shrinks --actors to "
                       f"{args.actors}", file=sys.stderr)
-    # --platform tpu: no forcing, let init fail loudly in-process.
+    else:
+        # --platform tpu used to let jax.devices() init in-process —
+        # the silent 90s hang of r03–r05. Probe in a subprocess with a
+        # timeout instead, and make failure FAST and EXPLICIT: a
+        # parseable tpu_init_error with the probed env snapshot.
+        plat, tpu_error = probe_tpu(args.probe_timeout,
+                                    args.probe_budget)
+        if plat is None:
+            print(json.dumps({
+                "error": "tpu_init_failed", "detail": tpu_error,
+                "backend_init_s": round(time.monotonic() - t_init, 1),
+                "tpu_env": tpu_env_details()}))
+            sys.exit(1)
 
     import jax
     plat = jax.devices()[0].platform
+    backend_init_s = time.monotonic() - t_init
 
     # Persistent compile cache (tuning.enable_compile_cache): the
     # second run of an identical bench reloads its executables instead
@@ -436,6 +529,15 @@ def main():
                                  fused=ub["pallas_fused"])
     except Exception as e:                       # noqa: BLE001
         run_loop = {"error": str(e)}
+    # Causal-tracing smoke (--trace-smoke): the standing record that
+    # trace propagation + reassembly hold on this platform.
+    tracing_block = None
+    if args.trace_smoke:
+        try:
+            tracing_block = bench_trace_smoke(
+                args, delivery=ub["delivery"], fused=ub["pallas_fused"])
+        except Exception as e:                   # noqa: BLE001
+            tracing_block = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -458,6 +560,7 @@ def main():
             "build_s": round(ub["build_s"], 1),
             "warmup_s": round(ub["warmup_s"], 1),
             "platform": plat,
+            "backend_init_s": round(backend_init_s, 1),
             "p50_dispatch_latency_us": round(lat["p50_us"], 1),
             "p90_dispatch_latency_us": round(lat["p90_us"], 1),
             "host_roundtrip_us": round(lat["host_roundtrip_us"], 1),
@@ -477,8 +580,11 @@ def main():
         # §9) — the standing record of this PR's win.
         "run_loop": run_loop,
     }
+    if tracing_block is not None:
+        result["tracing"] = tracing_block
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error
+        result["detail"]["tpu_env"] = tpu_env_details()
     print(json.dumps(result))
 
 

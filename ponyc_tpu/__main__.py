@@ -36,6 +36,11 @@ Commands:
                               annotations. Exit codes as for verify
                               (1 = findings at error or warning
                               severity).
+  trace <csv> [-o F]          analysis CSVs → Chrome-trace/Perfetto
+        [--spans F.jsonl]     JSON (counter tracks + causal-trace span
+  trace --tree <spans.jsonl>  slices with sender→receiver flow arrows);
+                              --tree prints reassembled causal trees
+                              with per-trace critical-path latency.
   top [<analytics.csv>]       live terminal view of a running runtime's
       [--interval S] [--once]  window stream (the level-2 CSV at
                               RuntimeOptions.analysis_path): window
@@ -317,8 +322,45 @@ def cmd_lint(argv) -> int:
 def cmd_trace(argv) -> int:
     """Convert analysis CSVs to a Chrome-trace/Perfetto JSON (≙ the
     dtrace/systemtap timeline scripts, examples/dtrace/telemetry.d):
-    ponyc_tpu trace <analytics.csv> [-o out.trace.json]."""
+
+        ponyc_tpu trace <analytics.csv> [-o out.trace.json]
+                        [--spans <spans.jsonl>]
+        ponyc_tpu trace --tree <spans.jsonl>
+
+    The first form merges the window/counter tracks with the causal-
+    trace span slices + sender→receiver flow arrows (PROFILE.md §10;
+    `--spans` overrides the `<csv>.spans.jsonl` default). The second
+    prints the reassembled causal trees — one indented tree per
+    sampled trace with its critical-path latency in device ticks."""
+    if "--tree" in argv:
+        argv = [a for a in argv if a != "--tree"]
+        if not argv:
+            print("ponyc_tpu trace: --tree needs a <spans.jsonl> path",
+                  file=sys.stderr)
+            return 2
+        from .tracing import format_trace, load_spans, reassemble
+        try:
+            trees = reassemble(load_spans(argv[0]))
+        except OSError as e:
+            print(f"ponyc_tpu trace: {e}", file=sys.stderr)
+            return 2
+        if not trees:
+            print("(no spans recorded — is tracing on? "
+                  "RuntimeOptions(analysis=3, trace_sample=N))")
+            return 0
+        for tid in sorted(trees):
+            print(format_trace(tid, trees[tid]))
+        return 0
     out = "trace.json"
+    spans = None
+    if "--spans" in argv:
+        i = argv.index("--spans")
+        if i + 1 >= len(argv):
+            print("ponyc_tpu trace: --spans needs a path",
+                  file=sys.stderr)
+            return 2
+        spans = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     if "-o" in argv:
         i = argv.index("-o")
         if i + 1 >= len(argv):
@@ -331,7 +373,11 @@ def cmd_trace(argv) -> int:
               "(RuntimeOptions.analysis_path)", file=sys.stderr)
         return 2
     from .analysis import chrome_trace
-    print(chrome_trace(argv[0], out))
+    try:
+        print(chrome_trace(argv[0], out, spans_path=spans))
+    except OSError as e:
+        print(f"ponyc_tpu trace: {e}", file=sys.stderr)
+        return 2
     return 0
 
 

@@ -160,6 +160,7 @@ class Analysis:
     def window(self, aux, ticks=None, gap_us=None) -> None:
         if self.level >= 3:
             self._drain_events()
+            self._drain_spans()
         if self.level < 2:
             return
         rt = self.rt
@@ -243,6 +244,21 @@ class Analysis:
         rt.state = _dc.replace(st, ev_count=jnp.zeros_like(st.ev_count))
         rt._freelist_key = fkey       # count reset frees no slots
 
+    def _drain_spans(self) -> None:
+        """Pull the device span ring through the runtime's Tracer
+        (causal tracing, PROFILE.md §10) and stream any fresh spans —
+        device AND host — to `<analysis_path>.spans.jsonl` as one-line
+        JSON records via the writer thread."""
+        tracer = getattr(self.rt, "_tracer", None)
+        if tracer is None:
+            return
+        tracer.drain(self.rt)
+        if self.level < 2:
+            return
+        from .tracing import span_jsonl_line
+        for rec in tracer.take_fresh():
+            self._rows.put(("span", span_jsonl_line(rec)))
+
     def _write_loop(self) -> None:
         opts = self.rt.opts
         # Batched flushing (satellite fix): flush-per-row serialised the
@@ -254,6 +270,8 @@ class Analysis:
         flush_s = max(0.0, getattr(opts, "analysis_flush_ms", 200) / 1e3)
         ev_f = open(opts.analysis_path + ".events.csv", "w") \
             if self.level >= 3 else None
+        sp_f = open(opts.analysis_path + ".spans.jsonl", "w") \
+            if getattr(self.rt, "_tracer", None) is not None else None
         dirty = []
         last_flush = time.monotonic()
 
@@ -281,6 +299,10 @@ class Analysis:
                                    + "\n")
                         if ev_f not in dirty:
                             dirty.append(ev_f)
+                    elif isinstance(row, tuple) and row[0] == "span":
+                        sp_f.write(row[1] + "\n")
+                        if sp_f not in dirty:
+                            dirty.append(sp_f)
                     else:
                         f.write(",".join(str(x) for x in row) + "\n")
                         if f not in dirty:
@@ -291,6 +313,8 @@ class Analysis:
         finally:
             if ev_f is not None:
                 ev_f.close()
+            if sp_f is not None:
+                sp_f.close()
 
     # -- live-world dump (level >= 1; SIGTERM/SIGUSR1 and run() end) --
     def dump(self, out=None) -> str:
@@ -322,6 +346,26 @@ class Analysis:
             lines.append(
                 f"events_pending={int(np.asarray(rt.state.ev_count).sum())} "
                 f"events_dropped={int(np.asarray(rt.state.ev_dropped).sum())}")
+        # Causal tracing (PROFILE.md §10): the per-trace rows — how many
+        # traces are live, their span counts, and the latest trace's
+        # critical-path latency in device ticks.
+        tracer = getattr(rt, "_tracer", None)
+        if tracer is not None:
+            try:
+                trees = rt.traces()
+            except Exception:           # mid-teardown: degrade
+                trees = None
+            if trees is not None:
+                lines.append(
+                    f"traces={len(trees)} "
+                    f"spans={sum(t['n_spans'] for t in trees.values())} "
+                    f"span_dropped={tracer.dropped}")
+                for tid in sorted(trees)[-3:]:
+                    t = trees[tid]
+                    lines.append(
+                        f"  trace {tid}: spans={t['n_spans']} "
+                        f"latency={t['latency']} ticks  "
+                        + " -> ".join(t["critical_path"][:6]))
         # Memory accounting (≙ USE_MEMTRACK counters, scheduler.h:52-66):
         # native pool blocks + host-heap handles.
         try:
@@ -427,6 +471,10 @@ class Analysis:
             self.dump()
 
     def close(self) -> None:
+        try:
+            self._drain_spans()    # tail spans after the last window
+        except Exception:          # teardown must never raise here
+            pass
         self._stop.set()
         if self._writer is not None:
             self._writer.join(timeout=2.0)
@@ -451,7 +499,8 @@ def attach(rt) -> Analysis:
 
 
 def chrome_trace(csv_path: str, out_path: str,
-                 events_path: Optional[str] = None) -> str:
+                 events_path: Optional[str] = None,
+                 spans_path: Optional[str] = None) -> str:
     """Convert the analysis CSVs into a Chrome-trace / Perfetto JSON.
 
     ≙ the reference's DTrace/SystemTap scripts turning USDT probes into
@@ -461,12 +510,17 @@ def chrome_trace(csv_path: str, out_path: str,
     window, anomalies), the dynamic per-behaviour `run:` columns become
     one counter track per HOT behaviour (any nonzero window — the
     per-op attribution timeline), the `qw50:`/`qw99:` columns one
-    queue-wait track per cohort, and the level-3 event CSV becomes
-    instant events (MUTE/UNMUTE/OVERLOAD/SPAWN/DESTROY/ERROR, one
-    thread lane per class) — load the output in chrome://tracing or
-    ui.perfetto.dev. Pre-profiler CSVs (no dynamic columns) still
-    convert. `events_path` defaults to `<csv_path>.events.csv` when
-    that file exists."""
+    queue-wait track per cohort, the level-3 event CSV becomes instant
+    events (MUTE/UNMUTE/OVERLOAD/SPAWN/DESTROY/ERROR, one thread lane
+    per class), and the causal-trace span stream (PROFILE.md §10)
+    becomes duration slices with sender→receiver FLOW ARROWS on a
+    second, device-tick-timebased process — load the output in
+    chrome://tracing or ui.perfetto.dev. Every process and thread lane
+    carries name (and sort-index) metadata so Perfetto labels tracks
+    instead of showing bare pids/tids; pre-profiler CSVs (no dynamic
+    columns) still convert. `events_path` defaults to
+    `<csv_path>.events.csv` and `spans_path` to
+    `<csv_path>.spans.jsonl` when those files exist."""
     import csv as _csv
     import json
     import os
@@ -475,6 +529,8 @@ def chrome_trace(csv_path: str, out_path: str,
     out = [
         {"ph": "M", "pid": pid, "name": "process_name",
          "args": {"name": "ponyc_tpu runtime"}},
+        {"ph": "M", "pid": pid, "name": "process_sort_index",
+         "args": {"sort_index": 0}},
         {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
          "args": {"name": "step windows"}},
     ]
@@ -515,19 +571,30 @@ def chrome_trace(csv_path: str, out_path: str,
         events_path = cand if os.path.exists(cand) else None
     if events_path is not None:
         tids = {}
+        evs = []
         with open(events_path) as f:
             for row in _csv.DictReader(f):
                 name = row["event"]
                 tid = tids.setdefault(name, len(tids) + 1)
-                out.append({"ph": "i", "pid": pid, "tid": tid, "s": "t",
+                evs.append({"ph": "i", "pid": pid, "tid": tid, "s": "t",
                             "ts": float(row["time_ms"]) * 1e3,
                             "name": f"{name} a{row['actor']}",
                             "args": {"actor": int(row["actor"]),
                                      "step": int(row["step"])}})
+        # Metadata BEFORE the events they label: Perfetto resolves
+        # track names on first sight of a tid (the satellite fix —
+        # bare-pid tracks came from late/absent name records).
         for name, tid in tids.items():
             out.append({"ph": "M", "pid": pid, "tid": tid,
                         "name": "thread_name",
                         "args": {"name": f"events:{name}"}})
+        out.extend(evs)
+    if spans_path is None:
+        cand = csv_path + ".spans.jsonl"
+        spans_path = cand if os.path.exists(cand) else None
+    if spans_path is not None:
+        from .tracing import load_spans, perfetto_events
+        out.extend(perfetto_events(load_spans(spans_path)))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": out,
                    "displayTimeUnit": "ms"}, f)
@@ -543,15 +610,36 @@ def top_frame(csv_path: str) -> str:
     pressure, GC, the per-behaviour run table and per-cohort
     queue-wait percentiles."""
     import csv as _csv
-    with open(csv_path) as f:
-        rows = list(_csv.DictReader(f))
+    import os as _os
     head = f"ponyc_tpu top — {csv_path}"
+    try:
+        with open(csv_path) as f:
+            rows = list(_csv.DictReader(f))
+    except OSError:
+        rows = []
+    # Satellite fix: a fresh run's CSV is empty or header-only until
+    # the writer thread's first flush (analysis_flush_ms), and the
+    # last row can be a half-written line mid-append — neither may
+    # crash the live view. Keep only rows whose time_ms parses; with
+    # none left, render a calm waiting frame instead.
+    ok_rows = []
+    for r in rows:
+        try:
+            float(r.get("time_ms") or "")
+        except (TypeError, ValueError):
+            continue
+        ok_rows.append(r)
+    rows = ok_rows
     if not rows:
-        return head + "\n(no windows written yet)"
+        return (head + "\n(waiting for samples — no windows written "
+                "yet; is a runtime with analysis>=2 running?)")
 
     def iv(row, k):
         v = row.get(k)
-        return int(float(v)) if v not in (None, "") else 0
+        try:
+            return int(float(v)) if v not in (None, "") else 0
+        except (TypeError, ValueError):
+            return 0
 
     last = rows[-1]
     prev = rows[-2] if len(rows) > 1 else None
@@ -607,4 +695,22 @@ def top_frame(csv_path: str) -> str:
         lines.append("queue-wait (ticks): " + "  ".join(
             f"{n} p50={iv(last, 'qw50:' + n)} "
             f"p99={iv(last, 'qw99:' + n)}" for n in qw_names))
+    # Causal traces (PROFILE.md §10): one row per recent trace from the
+    # writer's .spans.jsonl stream, newest last.
+    spans_path = csv_path + ".spans.jsonl"
+    if _os.path.exists(spans_path):
+        try:
+            from .tracing import load_spans, reassemble
+            trees = reassemble(load_spans(spans_path))
+        except Exception:
+            trees = {}
+        if trees:
+            lines.append("")
+            lines.append(f"traces: {len(trees)}")
+            for tid in sorted(trees)[-5:]:
+                t = trees[tid]
+                lines.append(
+                    f"  trace {tid}: spans={t['n_spans']} "
+                    f"latency={t['latency']} ticks  "
+                    + " -> ".join(t["critical_path"][:5]))
     return "\n".join(lines)

@@ -134,6 +134,24 @@ class RuntimeOptions:
     #   batch and flush when the queue drains or this many ms pass,
     #   whichever first (flush-per-row serialised the writer under
     #   level-3 event bursts); 0 = flush after every batch
+    # --- causal message tracing (PROFILE.md §10; ≙ the fork's per-event
+    # analysis following one message send→dispatch, analysis.c:587-692 —
+    # here a sampled TRACE CONTEXT rides every message: mailbox ring
+    # slots gain (trace_id, parent_span) side lanes, dispatch records a
+    # span per traced message in a bounded device ring, and every send/
+    # spawn the behaviour performs inherits the context. Active only
+    # when BOTH analysis >= 3 and trace_sample > 0; otherwise every
+    # trace lane is zero-length and the step jaxpr is bit-identical to
+    # a tracer-free build (tests/test_tracing.py asserts it). ---
+    trace_sample: int = 0          # 0 = off; N >= 1 samples one in N
+    #   host injections (send()); 1 traces every injection. Sampling is
+    #   deterministic under trace_seed (a counter hash, not wall clock),
+    #   so identical runs trace identical messages. Explicit ids via
+    #   send(..., trace=...) are always traced regardless of N.
+    trace_slots: int = 4096        # device span-ring entries per shard;
+    #   overflow between two drains drops spans and counts them
+    #   (state.span_dropped) — raise for deep fan-outs
+    trace_seed: int = 0            # sampling-hash seed (determinism knob)
     pallas: Union[bool, str] = False   # route the dispatch mailbox drain
     #   through the Pallas kernel (ops/mailbox_kernel.py) instead of the
     #   XLA select-chain; interpret-mode on CPU. "auto" adds the kernel
@@ -255,6 +273,11 @@ class RuntimeOptions:
             raise ValueError("tuning_ticks must be >= 0 (0 = auto)")
         if self.analysis_flush_ms < 0:
             raise ValueError("analysis_flush_ms must be >= 0")
+        if self.trace_sample < 0:
+            raise ValueError(
+                "trace_sample must be >= 0 (0 = off, N = 1-in-N)")
+        if self.trace_slots < 1:
+            raise ValueError("trace_slots must be >= 1")
         if self.blob_slots < 0 or self.blob_words < 0:
             raise ValueError("blob_slots/blob_words must be >= 0")
         if (self.blob_slots > 0) != (self.blob_words > 0):
@@ -266,6 +289,19 @@ class RuntimeOptions:
                 "shards x blob_slots must stay below 2^20 (handle "
                 "encoding reserves the high bits for the slot "
                 "generation; ops/pack.py BLOB_GEN_SHIFT)")
+
+    @property
+    def tracing(self) -> bool:
+        """Causal tracing active: both the analysis level and the
+        sampling knob must opt in (PROFILE.md §10)."""
+        return self.analysis >= 3 and self.trace_sample > 0
+
+    @property
+    def trace_lanes(self) -> int:
+        """Extra word rows every in-flight message carries when tracing
+        is on: (trace_id, parent_span). 0 when off — inject buffers,
+        spill tables and outbox entries keep the tracer-free width."""
+        return 2 if self.tracing else 0
 
     @property
     def overload_occ(self) -> int:

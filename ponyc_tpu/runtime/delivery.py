@@ -56,6 +56,12 @@ class Entries(NamedTuple):
 
 class DeliveryResult(NamedTuple):
     buf: dict                  # {type: [cap, 1+W_c, rows_c]} per cohort
+    trace_buf: dict            # {type: [cap, 2, rows_c]} causal-trace
+    #                               side lanes (tracing on only; {}
+    #                               when off) — rebuilt with the SAME
+    #                               gather as buf so a delivered
+    #                               message and its context can never
+    #                               land in different slots
     tail: jnp.ndarray
     spill: Entries             # rejected entries, compacted, oldest first
     spill_count: jnp.ndarray   # [] int32
@@ -97,7 +103,7 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             mailbox_cap: int, spill_cap: int, overload_occ: int,
             shard_base, cohort_layout, mute_slots: int = 4, level=None,
             n_levels: int = 1, plan=None, pressured=None,
-            cosort: bool = False) -> DeliveryResult:
+            cosort: bool = False, trace_buf=None) -> DeliveryResult:
     """`buf` is the per-cohort mailbox dict {type: [cap, 1+W_c, rows_c]};
     `cohort_layout` = [(type, s0, s1, w1_c)] tiles the local row space
     [0, n_local) in cohort order — bookkeeping (tails, segments, spill)
@@ -111,7 +117,16 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
     priority — when a mailbox can't take everything this tick, higher
     priority wins the slots and lower priority spills. Level 0 is
     reserved for receiver-spill entries (FIFO: older must land first),
-    level 1 for host injections."""
+    level 1 for host injections.
+
+    `trace_buf` (causal tracing on only): the per-cohort (trace_id,
+    parent_span) side-lane tables; `words` then carries TWO extra
+    trailing rows (the in-flight context) that both formulations move
+    with the payload — the plan path through the cached permutation,
+    the cosort path inside the one multi-operand sort — and the
+    per-cohort rebuild writes `trace_buf` with the same masks/sources
+    as `buf`. Spilled entries keep their trailing context rows (the
+    spill tables are trace-width, state.init_state)."""
     n, c = n_local, mailbox_cap
     tgt, sender, words = entries
     e = tgt.shape[0]
@@ -228,6 +243,20 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             buf2[cname] = jnp.where(wmasks[:, None, s0:s1],
                                     pulled.transpose(1, 0, 2),
                                     buf[cname])
+        # Trace side lanes (causal tracing on): the trailing two word
+        # rows land in trace_buf through the SAME (mask, source) pair
+        # as the payload — context and message are inseparable.
+        tbuf2 = {}
+        if trace_buf is not None:
+            w1f = wds.shape[0]
+            for cname, s0, s1, _w1c in cohort_layout:
+                nn = s1 - s0
+                pulled = jnp.take(wds[w1f - 2:],
+                                  srcs[:, s0:s1].reshape(c * nn),
+                                  axis=1).reshape(2, c, nn)
+                tbuf2[cname] = jnp.where(wmasks[:, None, s0:s1],
+                                         pulled.transpose(1, 0, 2),
+                                         trace_buf[cname])
 
         n_delivered = jnp.sum(acc)
         nrej = jnp.sum(cnt - acc)
@@ -281,20 +310,22 @@ def deliver(buf, head, tail, alive, entries: Entries, *, n_local: int,
             any_pressure = any_pressure | jnp.any(pressured[ktc] & (kt < n))
         spill, newly_muted, new_refs, new_ovf = lax.cond(
             any_pressure, pressure, lambda _: _empty_spill(), operand=None)
-        return (buf2, new_tail, spill, newly_muted, new_refs, new_ovf,
-                n_delivered, nrej)
+        return (buf2, tbuf2, new_tail, spill, newly_muted, new_refs,
+                new_ovf, n_delivered, nrej)
 
     def no_msgs(_):
         spill, newly_muted, new_refs, new_ovf = _empty_spill()
-        return (buf, tail, spill, newly_muted, new_refs, new_ovf,
+        return (buf, dict(trace_buf) if trace_buf is not None else {},
+                tail, spill, newly_muted, new_refs, new_ovf,
                 jnp.int32(0), jnp.int32(0))
 
-    (buf_out, new_tail, spill, newly_muted, new_refs, new_ovf, n_delivered,
-     nrej) = lax.cond(jnp.any(valid), with_msgs, no_msgs, operand=None)
+    (buf_out, tbuf_out, new_tail, spill, newly_muted, new_refs, new_ovf,
+     n_delivered, nrej) = lax.cond(jnp.any(valid), with_msgs, no_msgs,
+                                   operand=None)
 
     n_deadletter = jnp.sum(to_dead.astype(jnp.int32))
     return DeliveryResult(
-        buf=buf_out, tail=new_tail,
+        buf=buf_out, trace_buf=tbuf_out, tail=new_tail,
         spill=spill, spill_count=jnp.minimum(nrej, spill_cap),
         spill_overflow=nrej > spill_cap,
         newly_muted=newly_muted, new_mute_refs=new_refs,

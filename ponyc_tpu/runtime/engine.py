@@ -465,6 +465,131 @@ def profile_lanes(program: Program, opts: RuntimeOptions, st: RtState,
     return beh_runs, beh_del, beh_rej, coh_mt, qw_hist, qw_enq
 
 
+def trace_span_lanes(program: Program, opts: RuntimeOptions, st: RtState,
+                     drain_facts, base, shard):
+    """Causal-tracing lanes (PROFILE.md §10; ≙ the fork's per-event
+    analysis rows following one message send→dispatch,
+    analysis.c:587-692 — per MESSAGE here, where profile_lanes is per
+    aggregate). ONLY traced when opts.tracing: the caller gates the
+    call itself, so with tracing off none of this exists in the jaxpr
+    (tests/test_tracing.py traps this function to prove it).
+
+    Works entirely from the ring-advance facts (profile_lanes'
+    recomputation trick), so ONE implementation covers both dispatch
+    formulations (the XLA scan and the fused Pallas kernel) and both
+    delivery formulations (plan and cosort):
+
+      - every drained ring slot whose trace_id side lane is >= 0
+        becomes a SPAN: a fresh even span id from the per-shard
+        monotonic counter (host spans are odd — tracing.py owns the
+        scheme), recorded in the bounded span ring as (trace_id,
+        span_id, parent_span, behaviour_gid, actor_gid, enqueue_tick
+        [the qwait_enq delivery stamp], dispatch_tick, retire_tick);
+        overflow between two host drains drops and counts;
+      - outbox PROPAGATION rows: entry (b, m, r) of the cohort's
+        outbox inherits (trace_id, span_id) of the message batch slot
+        b dispatched on lane r — sends AND spawns (constructor
+        messages ride the same outbox) continue the causal chain; the
+        rows-minor [batch, ms, rows] flatten matches both the scan's
+        stack and the fused kernel's layout, so neither dispatch path
+        needs to know tracing exists.
+
+    `drain_facts` = [(cohort, head_before, head_after)] in
+    device-cohort order. Returns (span_data, span_count, span_dropped,
+    span_next, [per-cohort [2, e_c] propagation rows])."""
+    cap = opts.mailbox_cap
+    p = program.shards
+    ts_cap = opts.trace_slots
+    s_now = st.step_no[0]
+    span_data = st.span_data
+    span_count = st.span_count[0]
+    span_dropped = st.span_dropped[0]
+    span_next = st.span_next[0]
+    ci = jnp.arange(cap, dtype=jnp.int32)[:, None]
+    tr_out = []
+    for (ch, head0, head1) in drain_facts:
+        cname = ch.atype.__name__
+        rows = ch.local_capacity
+        batch, ms = ch.batch, ch.max_sends
+        n_con = head1 - head0
+        drained = ((ci - head0[None, :]) % cap) < n_con[None, :]
+        tid = st.trace_buf[cname][:, 0, :]            # [cap, rows]
+        tparent = st.trace_buf[cname][:, 1, :]
+        traced = drained & (tid >= 0)
+        e = rows * batch * ms
+
+        def busy(_):
+            """Span allocation + ring write + propagation — runs under
+            a cond so ticks where this COHORT dispatched no traced
+            message skip the compaction sort and scatters entirely
+            (the ev-ring discipline, §5b: the structural cost of
+            tracing scales with traced traffic, not with enabling the
+            knob)."""
+            sd = span_data
+            flat = traced.reshape(-1)                 # cap-major order
+            rank = jnp.cumsum(flat.astype(jnp.int32)) - 1
+            total = jnp.sum(flat.astype(jnp.int32))
+            sid_flat = jnp.where(
+                flat, ((span_next + rank) * p + shard) * 2 + 2,
+                jnp.int32(0))
+            k_sp = min(ts_cap, cap * rows)
+            perm, valid2, _tot = compact_mask(flat, k_sp)
+            pos = span_count + jnp.arange(k_sp, dtype=jnp.int32)
+            ok = valid2 & (pos < ts_cap)
+            posc = jnp.where(ok, pos, ts_cap)
+            actor = jnp.broadcast_to(
+                (base + ch.local_start
+                 + jnp.arange(rows, dtype=jnp.int32))[None, :],
+                (cap, rows)).reshape(-1)
+            vals = (tid.reshape(-1), sid_flat, tparent.reshape(-1),
+                    st.buf[cname][:, 0, :].reshape(-1), actor,
+                    st.qwait_enq[cname].reshape(-1),
+                    jnp.broadcast_to(s_now, (cap * rows,)),
+                    jnp.broadcast_to(s_now + 1, (cap * rows,)))
+            for ri, v in enumerate(vals):
+                sd = sd.at[ri, posc].set(
+                    jnp.where(ok, v[perm], 0), mode="drop")
+            # --- propagation rows for this cohort's outbox.
+            sid = sid_flat.reshape(cap, rows)
+            tid_b, sid_b = [], []
+            for b in range(batch):
+                slot = (head0 + b) % cap
+                tb, sb = tid[0], sid[0]
+                for cslot in range(1, cap):   # static select chain,
+                    sel = slot == cslot       # like _ring_take
+                    tb = jnp.where(sel, tid[cslot], tb)
+                    sb = jnp.where(sel, sid[cslot], sb)
+                okb = (b < n_con) & (tb >= 0)
+                tid_b.append(jnp.where(okb, tb, jnp.int32(-1)))
+                sid_b.append(jnp.where(okb, sb, jnp.int32(0)))
+            if ms:
+                tid_e = jnp.broadcast_to(
+                    jnp.stack(tid_b)[:, None, :],
+                    (batch, ms, rows)).reshape(e)
+                sid_e = jnp.broadcast_to(
+                    jnp.stack(sid_b)[:, None, :],
+                    (batch, ms, rows)).reshape(e)
+            else:
+                tid_e = jnp.full((0,), -1, jnp.int32)
+                sid_e = jnp.zeros((0,), jnp.int32)
+            return (sd,
+                    jnp.minimum(span_count + total, ts_cap),
+                    span_dropped + jnp.maximum(
+                        0, span_count + total - ts_cap),
+                    span_next + total,
+                    jnp.stack([tid_e, sid_e]))
+
+        def quiet(_):
+            return (span_data, span_count, span_dropped, span_next,
+                    jnp.stack([jnp.full((e,), -1, jnp.int32),
+                               jnp.zeros((e,), jnp.int32)]))
+
+        (span_data, span_count, span_dropped, span_next,
+         tr_pair) = lax.cond(jnp.any(traced), busy, quiet, operand=None)
+        tr_out.append(tr_pair)
+    return span_data, span_count, span_dropped, span_next, tr_out
+
+
 def _cohort_dispatch(cohort: Cohort, opts: RuntimeOptions, noyield: bool,
                      program: Program):
     """Build the planar per-cohort drain loop.
@@ -1063,6 +1188,7 @@ def build_step(program: Program, opts: RuntimeOptions):
     c = opts.mailbox_cap
     fh = program.first_host_row
     s_cap = opts.spill_cap
+    tracing = opts.tracing   # static: causal trace lanes (PROFILE §10)
     dev_cohorts = program.device_cohorts
     dispatchers = [(_cohort_dispatch(ch, opts, opts.noyield, program), ch)
                    for ch in dev_cohorts]
@@ -1505,6 +1631,21 @@ def build_step(program: Program, opts: RuntimeOptions):
                 ts[fname] = ts[fname].at[cols].set(val, mode="drop")
             new_type_state[tname] = ts
 
+        # --- 2c. causal-trace spans + context propagation (tracing on
+        # only; the Python-level gate keeps the jaxpr bit-identical to
+        # a tracer-free build otherwise — tests/test_tracing.py traps
+        # trace_span_lanes to prove it). Every cohort's outbox gains
+        # two trailing word rows carrying (trace_id, span_id) of the
+        # dispatch that emitted each entry; spills, routing and
+        # delivery move them with the payload from here on.
+        if tracing:
+            (span_data2, span_count2, span_dropped2, span_next2,
+             tr_rows) = trace_span_lanes(program, opts, st, drain_facts,
+                                         base, shard)
+            out_entries = [
+                o._replace(words=jnp.concatenate([o.words, t], axis=0))
+                for o, t in zip(out_entries, tr_rows)]
+
         # --- 3. route (mesh) or pass through (single chip).
         rspill_e = Entries(st.rspill_tgt, st.rspill_sender, st.rspill_words)
         out_cat = Entries(
@@ -1584,7 +1725,8 @@ def build_step(program: Program, opts: RuntimeOptions):
                       level=lvl_all, n_levels=n_levels,
                       plan=(st.plan_key, st.plan_perm, st.plan_bounds),
                       pressured=st.pressured,
-                      cosort=(opts.delivery == "cosort"))
+                      cosort=(opts.delivery == "cosort"),
+                      trace_buf=st.trace_buf if tracing else None)
 
         # --- 4b. apply destroys (≙ ponyint_actor_setpendingdestroy +
         # ponyint_actor_destroy, actor.c:570-664): the slot dies at end of
@@ -1870,6 +2012,12 @@ def build_step(program: Program, opts: RuntimeOptions):
             beh_runs=beh_runs2, beh_delivered=beh_del2,
             beh_rejected=beh_rej2, coh_mute_ticks=coh_mt2,
             qwait_hist=qw_hist2, qwait_enq=qw_enq2,
+            trace_buf=res.trace_buf,
+            span_data=span_data2 if tracing else st.span_data,
+            span_count=(vec(span_count2) if tracing else st.span_count),
+            span_dropped=(vec(span_dropped2) if tracing
+                          else st.span_dropped),
+            span_next=(vec(span_next2) if tracing else st.span_next),
             plan_key=res.plan_key, plan_perm=res.plan_perm,
             plan_bounds=res.plan_bounds,
             world_bits=vec(wb_new),

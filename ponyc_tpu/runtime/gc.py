@@ -162,7 +162,11 @@ def build_gc(program: Program, opts: RuntimeOptions):
                 gid = words_arr[0]
                 g = jnp.clip(gid, 0, n_gids - 1)
                 inr = (gid >= 0) & (gid < n_gids) & (tgt_arr >= 0)
-                for w in range(words_arr.shape[0] - 1):
+                # Payload words only: with tracing on the spill tables
+                # carry two trailing (trace_id, parent_span) rows that
+                # are never refs.
+                for w in range(min(words_arr.shape[0] - 1,
+                                   opts.msg_words)):
                     rm = jnp.asarray(ref_mask_np)[g, w] & inr
                     refs = jnp.where(rm, words_arr[1 + w], -1)
                     marks0 = marks0.at[
@@ -304,7 +308,8 @@ def build_gc(program: Program, opts: RuntimeOptions):
                     gid = words_arr[0]
                     g = jnp.clip(gid, 0, n_gids - 1)
                     inr = (gid >= 0) & (gid < n_gids) & (tgt_arr >= 0)
-                    for w in range(words_arr.shape[0] - 1):
+                    for w in range(min(words_arr.shape[0] - 1,
+                                       opts.msg_words)):
                         bm = bmark(bm, words_arr[1 + w],
                                    bmask2[g, w] & inr)
                 # Queued-message handles: planes collected by the shared
@@ -355,6 +360,12 @@ def build_gc(program: Program, opts: RuntimeOptions):
             beh_rejected=st.beh_rejected,
             coh_mute_ticks=st.coh_mute_ticks,
             qwait_hist=st.qwait_hist, qwait_enq=st.qwait_enq,
+            # Trace lanes/span ring pass through: collection dispatches
+            # nothing, so no spans; dead rows' ring-slot lanes are
+            # unreadable (head := tail) and re-stamped on next delivery.
+            trace_buf=st.trace_buf, span_data=st.span_data,
+            span_count=st.span_count, span_dropped=st.span_dropped,
+            span_next=st.span_next,
             # Plan cache passes through: next step's key vector is
             # computed against the new `alive`, so deliveries to
             # collected actors invalidate it by comparison, not here.

@@ -87,10 +87,14 @@ class HostContext:
         self.exit_flag = False
         self.exit_code = 0
         self.yield_flag = False
+        self.trace_ctx = None   # (trace_id, span_id) of this dispatch
+        #   when causal tracing followed the message here — sends
+        #   below continue the chain (PROFILE.md §10)
 
     def send(self, target, behaviour_def, *args, when=True):
         if when:
-            self.rt.send(int(target), behaviour_def, *args)
+            self.rt.send(int(target), behaviour_def, *args,
+                         trace=self.trace_ctx)
 
     def exit(self, code=0, when=True):
         if when:
@@ -215,6 +219,8 @@ class Runtime:
         self._next_gc = self.opts.gc_initial   # ≙ heap.c next_gc
         self._host_errors: Dict[int, int] = {}
         self._host_error_locs: Dict[int, str] = {}
+        self._tracer = None      # tracing.Tracer, set by start() when
+        #   opts.tracing (analysis >= 3 and trace_sample > 0)
         self.tuning_record: Optional[Dict[str, Any]] = None   # set by
         #   start() when any option is "auto" (tuning.resolve): source
         #   (cache/calibrated/default), per-variant tick_ms table,
@@ -346,10 +352,20 @@ class Runtime:
         self._multi_g = engine.jit_multi_step_gated(
             self.program, self.opts, self.mesh)
         self._zero_aux = engine.zero_aux()
-        w1 = 1 + self.opts.msg_words
+        # Inject buffers carry the trace side lanes when causal tracing
+        # is on (two trailing rows: trace_id, parent_span — PROFILE §10).
+        w1 = 1 + self.opts.msg_words + self.opts.trace_lanes
         k = self.opts.inject_slots
         self._empty_inject = (jnp.full((k,), -1, jnp.int32),
                               jnp.zeros((w1, k), jnp.int32))
+        if self.opts.tracing:
+            from ..tracing import Tracer
+            self._tracer = Tracer(
+                self.opts.trace_sample, self.opts.trace_seed,
+                beh_names=[f"{b.actor_type.__name__}.{b.name}"
+                           for b in self.program.behaviour_table])
+        else:
+            self._tracer = None
         for cohort in self.program.cohorts:
             self._free[cohort.atype.__name__] = list(
                 range(cohort.capacity - 1, -1, -1))
@@ -531,7 +547,8 @@ class Runtime:
             slot = pack.blob_slot(int(h))
             if h >= 0 and 0 <= slot < n_blob_total:
                 blob_roots[slot] = True
-        for t, w in itertools.chain(self._inject_q, self._host_fast_q):
+        for t, w, *_ in itertools.chain(self._inject_q,
+                                        self._host_fast_q):
             if 0 <= t < self.program.total:
                 extra[t] = True
             gid = int(w[0])
@@ -657,17 +674,46 @@ class Runtime:
                 "payloads")
 
     # ---- external sends (≙ pony_sendv from outside the runtime) ----
-    def send(self, target: int, behaviour_def: BehaviourDef, *args):
+    def _trace_context(self, trace):
+        """Resolve a send's causal-trace context to (trace_id,
+        parent_span) or (-1, 0) (untraced). `trace` spellings: None =
+        the deterministic sampler decides (1-in-trace_sample); an int =
+        an explicit caller trace id (the bridge/ingress tier tying a
+        socket request to its device spans — always traced, root span
+        get-or-created); a (trace_id, span_id) tuple = continue an
+        existing span (host-behaviour propagation)."""
+        tr = self._tracer
+        if tr is None:
+            return -1, 0
+        step = self.steps_run
+        if isinstance(trace, tuple):
+            return int(trace[0]), int(trace[1])
+        if trace is not None:
+            tid = int(trace)
+            return tid, tr.root_span(tid, step)
+        if tr.sample():
+            return tr.begin(step)
+        return -1, 0
+
+    def send(self, target: int, behaviour_def: BehaviourDef, *args,
+             trace=None):
         if behaviour_def.global_id is None:
             raise RuntimeError(f"{behaviour_def} not part of this program")
         self._check_send_target(int(target), behaviour_def)
         self._check_ref_args(behaviour_def.arg_specs, args,
                              f"{behaviour_def.actor_type.__name__}."
                              f"{behaviour_def.name}")
-        words = np.zeros((1 + self.opts.msg_words,), np.int32)
+        tlanes = self.opts.trace_lanes
+        words = np.zeros((1 + self.opts.msg_words + tlanes,), np.int32)
         words[0] = behaviour_def.global_id
-        words[1:] = _host_pack_args(behaviour_def.arg_specs, args,
-                                    self.opts.msg_words)
+        words[1:1 + self.opts.msg_words] = _host_pack_args(
+            behaviour_def.arg_specs, args, self.opts.msg_words)
+        tctx = None
+        if tlanes:
+            tid, psid = self._trace_context(trace)
+            words[-2], words[-1] = tid, psid
+            if tid >= 0:
+                tctx = (tid, psid)
         # Iso payload discipline at the host boundary (≙ the gc.c send
         # handler moving ownership with the message): mark the handle in
         # flight — peeking it now is use-after-send, re-sending it is an
@@ -705,15 +751,24 @@ class Runtime:
         if (self.opts.host_fastpath
                 and 0 <= int(target) < self.program.total
                 and self.program.cohort_of(int(target)).host):
-            self._host_fast_q.append((int(target), words))
+            # Fast-lane messages never touch the device, so the trace
+            # context rides the queue entry instead of word lanes.
+            self._host_fast_q.append((int(target), words, tctx))
         else:
             self._inject_q.append((int(target), words))
 
-    def bulk_send(self, targets, behaviour_def: BehaviourDef, *arg_cols):
+    def bulk_send(self, targets, behaviour_def: BehaviourDef, *arg_cols,
+                  trace=None):
         """Mass-enqueue one message per (distinct) target directly into the
         device mailboxes — the setup path for benchmark-scale seeding
         (injecting 1M messages through the per-step inject buffer would
         take thousands of steps). Targets must be unique within one call.
+
+        `trace` (causal tracing on only): an explicit caller trace id —
+        every seeded message joins that trace (one root, N branches;
+        the ingress tier's batched-request hook). None = untraced (the
+        sampler never fires here: sampling one message of a bulk seed
+        would attribute the whole batch's cost to it).
         """
         targets = np.asarray(targets, np.int64)
         if len(np.unique(targets)) != len(targets):
@@ -791,6 +846,20 @@ class Runtime:
         new_cbuf = self.state.buf[cname].at[slot, :, cols].set(
             jnp.asarray(words[:, :w1c]))
         extra = {}
+        if self._tracer is not None:
+            # Stamp (or CLEAR — ring slots are recycled, a stale lane
+            # would adopt a previous message's trace) the trace side
+            # lanes for every written slot.
+            lanes = np.full((k, 2), -1, np.int32)
+            lanes[:, 1] = 0
+            if trace is not None:
+                tid = int(trace)
+                lanes[:, 0] = tid
+                lanes[:, 1] = self._tracer.root_span(tid, self.steps_run)
+            extra["trace_buf"] = {
+                **self.state.trace_buf,
+                cname: self.state.trace_buf[cname].at[slot, :, cols].set(
+                    jnp.asarray(lanes))}
         if cname in self.state.qwait_enq:
             # Profiler enqueue stamp (analysis >= 1): bulk_send bypasses
             # the in-step delivery that normally writes it, so stamp the
@@ -816,7 +885,7 @@ class Runtime:
         if not self._inject_q:
             return (*self._empty_inject, [])
         k = self.opts.inject_slots
-        w1 = 1 + self.opts.msg_words
+        w1 = 1 + self.opts.msg_words + self.opts.trace_lanes
         tgt = np.full((k,), -1, np.int32)
         words = np.zeros((w1, k), np.int32)   # planar: word-major
         # Host-side flow control: at most one drain-batch per target per
@@ -951,6 +1020,7 @@ class Runtime:
         # Per-cohort mailbox tables: fetch each HOST cohort's table once
         # (at its own width) and read messages via cohort-local columns.
         host_bufs: Dict[str, np.ndarray] = {}
+        host_tbufs: Dict[str, np.ndarray] = {}   # trace side lanes
         c = self.opts.mailbox_cap
         new_head = head.copy()
         for i in np.nonzero(pending)[0]:
@@ -961,13 +1031,22 @@ class Runtime:
             if cbuf is None:
                 cbuf = host_bufs[cname] = np.asarray(
                     self.state.buf[cname])       # [cap, w1_c, capacity]
+                if self._tracer is not None:
+                    host_tbufs[cname] = np.asarray(
+                        self.state.trace_buf[cname])  # [cap, 2, cap_c]
             col = int(cohort.gid_to_col(aid))
             consumed = 0
             for k in range(int(pending[i])):
-                msg = cbuf[(head[i] + k) % c, :, col]
+                slot = (head[i] + k) % c
+                msg = cbuf[slot, :, col]
+                tctx = None
+                if self._tracer is not None:
+                    tlane = host_tbufs[cname][slot, :, col]
+                    if int(tlane[0]) >= 0:
+                        tctx = (int(tlane[0]), int(tlane[1]))
                 consumed += 1
                 ctx = self._dispatch_host_msg(aid, cohort, int(msg[0]),
-                                              msg[1:])
+                                              msg[1:], trace_ctx=tctx)
                 if ctx is not None and ctx.yield_flag:
                     break
             new_head[i] = head[i] + consumed
@@ -975,12 +1054,15 @@ class Runtime:
             head=self.state.head.at[rows_j].set(jnp.asarray(new_head)))
         return True
 
-    def _dispatch_host_msg(self, aid: int, cohort, gid: int, payload):
+    def _dispatch_host_msg(self, aid: int, cohort, gid: int, payload,
+                           trace_ctx=None):
         """Dispatch ONE message to a host-resident actor — shared by the
         device-mailbox drain above and the fast lane below so their
         semantics (iso receive, PonyError residue, exit/yield flags,
         counters) cannot drift. Returns the HostContext, or None for a
-        badmsg."""
+        badmsg. `trace_ctx` = the message's (trace_id, parent_span)
+        when causal tracing followed it here: the dispatch becomes a
+        HOST span and the behaviour's sends continue the chain."""
         bdef = (self.program.behaviour_table[gid]
                 if 0 <= gid < len(self.program.behaviour_table)
                 else None)
@@ -988,6 +1070,11 @@ class Runtime:
             self.totals["badmsg"] += 1
             return None
         ctx = HostContext(self, aid)
+        if trace_ctx is not None and self._tracer is not None:
+            tid, psid = trace_ctx
+            sid = self._tracer.host_span(tid, psid, gid, aid,
+                                         self.steps_run)
+            ctx.trace_ctx = (tid, sid)
         st = self._host_state.get(aid, {})
         args = _host_unpack_args(bdef.arg_specs, payload)
         heap = getattr(self, "_heap", None)
@@ -1035,16 +1122,18 @@ class Runtime:
         yielded = set()      # actors that yield_()ed: stop their batch
         held = []            # their remaining messages, order preserved
         while q and n < budget:
-            aid, w = q.popleft()
+            aid, w, tctx = q.popleft()
             if aid in yielded:
-                held.append((aid, w))
+                held.append((aid, w, tctx))
                 continue
             n += 1
             if aid not in self._host_state:
                 self.totals["deadletter_host"] += 1
                 continue
             cohort = self.program.cohort_of(aid)
-            ctx = self._dispatch_host_msg(aid, cohort, int(w[0]), w[1:])
+            ctx = self._dispatch_host_msg(
+                aid, cohort, int(w[0]),
+                w[1:1 + self.opts.msg_words], trace_ctx=tctx)
             if ctx is not None and ctx.yield_flag:
                 # ≙ the device drain honouring yield mid-batch
                 # (actor.c:675-679): this actor processes nothing more
@@ -1654,6 +1743,25 @@ class Runtime:
                 "aborted": self.totals.get("gc_aborted", 0),
             },
         }
+
+    def traces(self) -> Dict[int, Dict[str, Any]]:
+        """Reassembled causal traces (PROFILE.md §10): drains the
+        device span ring, merges host spans (injection roots, host-
+        cohort dispatches) and returns one causal tree per trace id —
+        ``{trace_id: {"roots", "spans", "n_spans", "latency",
+        "critical_path"}}`` with latency in device ticks (max retire −
+        min enqueue over the trace). Requires tracing on
+        (``analysis >= 3`` and ``trace_sample > 0``); sample with
+        ``RuntimeOptions(trace_sample=N)`` or pass an explicit id via
+        ``send(..., trace=...)`` / ``bulk_send(..., trace=...)``."""
+        if self._tracer is None:
+            raise RuntimeError(
+                "Runtime.traces() needs causal tracing on: "
+                "RuntimeOptions(analysis=3, trace_sample=N) (the trace "
+                "lanes compile away otherwise)")
+        from ..tracing import reassemble
+        self._tracer.drain(self)
+        return reassemble(self._tracer.spans)
 
     def state_of(self, actor_id: int) -> Dict[str, Any]:
         cohort = self.program.cohort_of(actor_id)

@@ -59,6 +59,12 @@ from ..program import Program
 # quantize() aggregations over the fork's USDT probes).
 QW_BUCKETS = 16
 
+# Span-ring record rows (causal tracing, PROFILE.md §10): the layout is
+# owned by tracing.py so the host reassembler and the device writer can
+# never drift. (trace_id, span_id, parent_span, behaviour_gid,
+# actor_gid, enqueue_tick, dispatch_tick, retire_tick.)
+from ..tracing import SPAN_ROWS  # noqa: E402  (after QW_BUCKETS on purpose)
+
 
 def layout_sizes(program: Program, opts: RuntimeOptions):
     """Static per-shard sizes shared by build_step and init_state:
@@ -207,6 +213,29 @@ class RtState:
     #                               enqueue-step stamp per ring slot
     #                               (device cohorts; {} when analysis<1)
 
+    # Causal tracing (analysis >= 3 AND trace_sample > 0; PROFILE.md
+    # §10; ≙ the fork's per-event rows following one message
+    # send→dispatch, analysis.c:587-692). {} / zero-length when off —
+    # the whole subsystem compiles away (engine.trace_span_lanes is
+    # never traced; tests/test_tracing.py pins jaxpr identity).
+    trace_buf: Dict[str, jnp.ndarray]  # {type: [cap, 2, capacity]}
+    #                               per-ring-slot (trace_id,
+    #                               parent_span) side lanes, written by
+    #                               delivery with the SAME gather as the
+    #                               payload rebuild; -1 = untraced.
+    #                               ALL cohorts (the host drain reads
+    #                               host-cohort lanes to continue
+    #                               traces through host behaviours)
+    span_data: jnp.ndarray    # [SPAN_ROWS, P*TS] int32 — span ring
+    #                               (tracing.SPAN_ROWS rows; TS =
+    #                               opts.trace_slots), drained by the
+    #                               analysis writer / Runtime.traces()
+    span_count: jnp.ndarray   # [P] int32 — valid entries since drain
+    span_dropped: jnp.ndarray  # [P] int32 — lifetime overflow drops
+    span_next: jnp.ndarray    # [P] int32 — monotonic span-id counter
+    #                               (device ids: even, unique across
+    #                               shards — see tracing.py)
+
     # Cached delivery plan (see delivery.py): when consecutive ticks carry
     # the same (target, level) key vector — any topology-stable traffic —
     # the sort permutation and segment bounds are reused instead of
@@ -270,7 +299,10 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     assert program.frozen, "finalize() the Program first"
     n = program.total
     p = program.shards
-    w1 = 1 + opts.msg_words
+    # Spill tables carry the full in-flight word width: payload plus
+    # the (trace_id, parent_span) lanes when tracing is on — a parked
+    # message must keep its causal context across the retry.
+    w1 = 1 + opts.msg_words + opts.trace_lanes
     c = opts.mailbox_cap
     s = opts.spill_cap * p
     _, _, n_entries = layout_sizes(program, opts)
@@ -342,6 +374,16 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         qwait_enq=({ch.atype.__name__: jnp.zeros((c, ch.capacity), i32)
                     for ch in program.device_cohorts}
                    if opts.analysis >= 1 else {}),
+        trace_buf=({ch.atype.__name__:
+                    jnp.full((c, 2, ch.capacity), -1, i32)
+                    for ch in program.cohorts}
+                   if opts.tracing else {}),
+        span_data=jnp.zeros(
+            (SPAN_ROWS, p * (opts.trace_slots if opts.tracing else 0)),
+            i32),
+        span_count=jnp.zeros((p,), i32),
+        span_dropped=jnp.zeros((p,), i32),
+        span_next=jnp.zeros((p,), i32),
         plan_key=jnp.full((p * n_entries,), -1, i32),
         plan_perm=jnp.zeros((p * n_entries,), i32),
         plan_bounds=jnp.zeros((p * (program.n_local + 1),), i32),

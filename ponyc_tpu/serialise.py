@@ -89,10 +89,13 @@ def save(rt, path: str) -> None:
     else:
         arrays["inject_words"] = np.zeros(
             (0, 1 + rt.opts.msg_words), np.int32)
+    # Fast-lane entries are (target, words[, trace_ctx]); the host
+    # trace bookkeeping (tracing.Tracer) is per-process and not
+    # snapshotted — a restored queue's messages deliver untraced.
     fast = list(rt._host_fast_q)
-    arrays["fastq_tgt"] = np.asarray([t for t, _ in fast], np.int32)
+    arrays["fastq_tgt"] = np.asarray([e[0] for e in fast], np.int32)
     if fast:
-        arrays["fastq_words"] = np.stack([w for _, w in fast])
+        arrays["fastq_words"] = np.stack([e[1] for e in fast])
     else:
         arrays["fastq_words"] = np.zeros(
             (0, 1 + rt.opts.msg_words), np.int32)
@@ -165,7 +168,7 @@ def restore(rt, path: str) -> None:
             ftgts = z["fastq_tgt"]
             fwords = z["fastq_words"]
             for i in range(len(ftgts)):
-                rt._host_fast_q.append((int(ftgts[i]), fwords[i]))
+                rt._host_fast_q.append((int(ftgts[i]), fwords[i], None))
     rt._free = {k: [int(x) for x in v] for k, v in header["free"].items()}
     rt._host_state = {int(k): v for k, v in header["host_state"].items()}
     rt._host_blobs = set(int(h) for h in header.get("host_blobs", ()))

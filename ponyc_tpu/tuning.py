@@ -393,7 +393,7 @@ def calibrate(program, opts: RuntimeOptions, mesh, state,
 
     k = _window_ticks(opts, sustain)
     repeats = opts.tuning_repeats
-    w1 = 1 + opts.msg_words
+    w1 = 1 + opts.msg_words + opts.trace_lanes
     slots = opts.inject_slots
     empty_inject = (jnp.full((slots,), -1, jnp.int32),
                     jnp.zeros((w1, slots), jnp.int32))

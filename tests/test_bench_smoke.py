@@ -81,6 +81,30 @@ def test_bench_telemetry_block(bench_mod):
     assert "gc_passes" in t and "mute_ticks" in t
 
 
+def test_bench_trace_smoke_block(bench_mod):
+    """The --trace-smoke `tracing` block (causal tracing, PROFILE.md
+    §10): one sampled injection reassembles with consistent span
+    ticks — attribution_ok style, recorded by every bench that opts
+    in."""
+    t = bench_mod.bench_trace_smoke(_args(), delivery="plan",
+                                    fused=False)
+    assert t["spans_ok"] and t["span_count_ok"]
+    assert t["traces"] == 1
+    assert t["spans"] == 25              # inject + one span per hop
+    assert t["max_latency_ticks"] >= 24
+    assert t["analysis"] == 3 and t["trace_sample"] == 1
+
+
+def test_tpu_env_details_shape(bench_mod):
+    """The tpu_init_error env snapshot: JSON-serialisable, secrets
+    filtered, libtpu presence probed."""
+    import json as _json
+    d = bench_mod.tpu_env_details()
+    _json.dumps(d)                       # must serialise
+    assert "libtpu_importable" in d
+    assert all("KEY" not in k and "TOKEN" not in k for k in d["env"])
+
+
 def test_tristate_parsing(bench_mod):
     assert bench_mod.tristate("auto") == "auto"
     assert bench_mod.tristate("on") is True
