@@ -46,14 +46,20 @@ went, not just totals. The timed headline pass itself stays level 0.
 
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
                         [--delivery auto|plan|cosort] [--fused auto|on|off]
-                        [--trace-smoke]
+                        [--trace-smoke] [--metrics-smoke]
 
 --trace-smoke adds a `tracing` block: one sampled causal-tracing pass
 (analysis=3, trace_sample=1, PROFILE.md §10) reassembled and checked
-(spans_ok/span_count_ok — attribution_ok style). Every run records
+(spans_ok/span_count_ok — attribution_ok style). --metrics-smoke adds
+a `metrics` block: a scrape-under-load round-trip through the real
+HTTP exporter (RuntimeOptions.metrics_port, PROFILE.md §11) whose
+final counters must equal Runtime.profile(). Every run records
 `backend_init_s`, and a failed TPU init — including --platform tpu,
 which now probes in a subprocess instead of hanging in-process — emits
-an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`).
+an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`)
+PLUS a flight-recorder `postmortem` (probe timeline + env) and the
+doctor's one-line diagnosis on stderr, so CPU-fallback rounds carry
+their stall evidence (`doctor --postmortem BENCH_rNN.json`).
 Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
        PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU /
        PONY_TPU_BENCH_DELIVERY / PONY_TPU_BENCH_FUSED override;
@@ -81,30 +87,37 @@ def probe_tpu(timeout_s: float, budget_s: float):
     erased the round's on-chip headline metric; observed wedges clear
     after tens of minutes).
 
-    Returns (platform_or_None, last_error)."""
+    Returns (platform_or_None, last_error, probe_timeline) — the
+    timeline is the attempt-by-attempt stall evidence the flight-
+    recorder postmortem embeds in every tpu_init_error BENCH json."""
     from ponyc_tpu.platforms import probe_accelerator
     deadline = time.monotonic() + budget_s
     err = None
     attempt = 0
+    timeline = []
     while True:
         attempt += 1
         remaining = deadline - time.monotonic()
         if remaining <= 5.0:
-            return None, err or "probe budget exhausted"
+            return None, err or "probe budget exhausted", timeline
         # First attempt: the configured timeout. Later attempts wait as
         # long as the budget allows (a claim that queues for minutes and
         # then succeeds beats five fast kills — killing a claim-waiting
         # client has been observed to re-wedge the tunnel).
         t = min(remaining, timeout_s if attempt == 1 else max(
             timeout_s, 300.0))
+        t0 = time.monotonic()
         plat, err = probe_accelerator(t)
+        timeline.append({"attempt": attempt, "timeout_s": round(t, 1),
+                         "t_s": round(time.monotonic() - t0, 1),
+                         "error": err})
         if plat is not None:
-            return plat, None
+            return plat, None, timeline
         if err and err.startswith("backend initialised as"):
             # Deterministic outcome — JAX resolved to CPU; retrying
             # would just re-init the same backend.
             print(f"bench: TPU probe: {err}", file=sys.stderr)
-            return None, err
+            return None, err, timeline
         print(f"bench: TPU probe attempt {attempt} failed "
               f"({remaining - t:.0f}s of budget left): {err}",
               file=sys.stderr)
@@ -120,17 +133,24 @@ def tpu_env_details():
     """The probed-environment snapshot that rides every tpu_init_error
     (satellite of ROADMAP item 2: benches r03–r05 regressed to CPU
     with nothing in the JSON saying WHY the backend died — this block
-    makes the failure diagnosable from the BENCH record alone)."""
-    import importlib.util
-    env = {k: v for k, v in sorted(os.environ.items())
-           if k.startswith(("TPU", "JAX", "LIBTPU", "PJRT", "XLA"))
-           and "KEY" not in k and "TOKEN" not in k and "SECRET" not in k}
-    details = {"env": env,
-               "libtpu_importable":
-                   importlib.util.find_spec("libtpu") is not None}
-    for dev in ("/dev/accel0", "/dev/vfio"):
-        details[f"dev:{dev}"] = os.path.exists(dev)
-    return details
+    makes the failure diagnosable from the BENCH record alone). Now
+    the shared flight-recorder snapshot (ponyc_tpu.flight): one
+    definition for BENCH jsons and runtime postmortems."""
+    from ponyc_tpu.flight import env_snapshot
+    return env_snapshot()
+
+
+def tpu_init_postmortem(timeline):
+    """Build the flight-recorder postmortem for a failed TPU init
+    (probe timeline + env snapshot), print the doctor's one-line
+    diagnosis to stderr (fail LOUDLY — a CPU-fallback round must not
+    read like a clean one), and return the postmortem dict for the
+    BENCH json."""
+    from ponyc_tpu.flight import diagnose_postmortem, probe_postmortem
+    pm = probe_postmortem(timeline, tpu_env_details())
+    line, _detail = diagnose_postmortem(pm)
+    print(f"bench: doctor: {line}", file=sys.stderr)
+    return pm
 
 
 def tristate(v):
@@ -349,6 +369,81 @@ def bench_trace_smoke(args, delivery="plan", fused=False):
     }
 
 
+def bench_metrics_smoke(args, delivery="plan", fused=False):
+    """Metrics-export smoke (PROFILE.md §11; --metrics-smoke): a small
+    seeded world served on an ephemeral metrics port, scraped OVER HTTP
+    while run() is live and again at quiescence — the standing record
+    that the scrape surface round-trips under load: /healthz answers
+    mid-run, the final Prometheus counters equal Runtime.profile(),
+    and the text parses. Bounded world, never allowed to sink a
+    headline run (main() guards with try/except)."""
+    import threading
+    import urllib.request
+
+    from ponyc_tpu import RuntimeOptions
+    from ponyc_tpu.metrics import parse_prometheus
+    from ponyc_tpu.models import ring
+
+    opts = RuntimeOptions(mailbox_cap=8, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=64, inject_slots=8,
+                          delivery=delivery, pallas_fused=fused,
+                          analysis=1, metrics_port=0,
+                          analysis_path="/tmp/pony_tpu.bench_metrics.csv")
+    rt, ids = ring.build(64, opts)
+    hops = 5000
+    rt.send(int(ids[0]), ring.RingNode.token, hops)
+    base = f"http://127.0.0.1:{rt._metrics.port}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=5.0) as r:
+            return r.read().decode()
+
+    live_status = None
+    live_scrapes = 0
+
+    def scrape_live():
+        nonlocal live_status, live_scrapes
+        while not done.is_set():
+            try:
+                live_status = json.loads(get("/healthz"))["status"]
+                parse_prometheus(get("/metrics"))
+                live_scrapes += 1
+            except OSError:
+                pass
+            time.sleep(0.02)
+
+    done = threading.Event()
+    t = threading.Thread(target=scrape_live, daemon=True)
+    t.start()
+    t0 = time.time()
+    rt.run()
+    elapsed = time.time() - t0
+    done.set()
+    t.join(timeout=5.0)
+    final = parse_prometheus(get("/metrics"))
+    hz = json.loads(get("/healthz"))
+    prof = rt.profile()
+    rt.stop()
+    counters_match = (
+        final.get(("pony_tpu_processed_total", ()))
+        == prof["totals"]["processed"]
+        and final.get(("pony_tpu_delivered_total", ()))
+        == prof["totals"]["delivered"]
+        and final.get(("pony_tpu_behaviour_runs_total",
+                       (("behaviour", "RingNode.token"),)))
+        == prof["behaviours"]["RingNode.token"]["runs"])
+    return {
+        "port": rt.opts.metrics_port,
+        "hops": hops,
+        "elapsed_s": round(elapsed, 3),
+        "live_scrapes": live_scrapes,
+        "live_status": live_status,
+        "final_status": hz["status"],
+        "scrape_ok": bool(live_scrapes > 0),
+        "counters_match": bool(counters_match),
+    }
+
+
 def bench_latency(args, delivery="plan", fused=False):
     """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
     one hop per tick. The headline number is the DEVICE-RESIDENT per-hop
@@ -455,26 +550,38 @@ def main():
                     help="run one sampled causal-tracing window "
                     "(analysis=3, trace_sample=1) and embed a "
                     "`tracing` block in the JSON (PROFILE.md §10)")
+    ap.add_argument("--metrics-smoke", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_METRICS_SMOKE", "0") == "1",
+                    help="scrape-under-load round-trip: serve a small "
+                    "world on an ephemeral metrics_port, scrape "
+                    "/metrics+/healthz over HTTP during run(), and "
+                    "embed a `metrics` block asserting the final "
+                    "counters equal Runtime.profile() (PROFILE.md §11)")
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
 
     allow_cpu = os.environ.get("PONY_TPU_BENCH_ALLOW_CPU", "1") != "0"
     tpu_error = None
+    tpu_pm = None        # flight-recorder postmortem of a failed init
     # Backend init wall-time: probe + first jax.devices(), the number
     # ROADMAP item 2's hang diagnosis needs in every BENCH record.
     t_init = time.monotonic()
     if args.platform == "cpu":
         force_cpu()
     elif args.platform == "auto":
-        plat, tpu_error = probe_tpu(args.probe_timeout, args.probe_budget)
+        plat, tpu_error, timeline = probe_tpu(args.probe_timeout,
+                                              args.probe_budget)
         if plat is None:
+            tpu_pm = tpu_init_postmortem(timeline)
             if not allow_cpu:
                 print(json.dumps({
                     "error": "tpu_init_failed", "detail": tpu_error,
                     "backend_init_s": round(
                         time.monotonic() - t_init, 1),
-                    "tpu_env": tpu_env_details()}))
+                    "tpu_env": tpu_env_details(),
+                    "postmortem": tpu_pm}))
                 sys.exit(1)
             print("bench: TPU unavailable — falling back to CPU "
                   "(PONY_TPU_BENCH_ALLOW_CPU=0 to make this fatal). "
@@ -491,14 +598,18 @@ def main():
         # --platform tpu used to let jax.devices() init in-process —
         # the silent 90s hang of r03–r05. Probe in a subprocess with a
         # timeout instead, and make failure FAST and EXPLICIT: a
-        # parseable tpu_init_error with the probed env snapshot.
-        plat, tpu_error = probe_tpu(args.probe_timeout,
-                                    args.probe_budget)
+        # parseable tpu_init_error carrying the flight-recorder
+        # postmortem (probe timeline + env snapshot) and the doctor's
+        # one-line diagnosis on stderr.
+        plat, tpu_error, timeline = probe_tpu(args.probe_timeout,
+                                              args.probe_budget)
         if plat is None:
+            tpu_pm = tpu_init_postmortem(timeline)
             print(json.dumps({
                 "error": "tpu_init_failed", "detail": tpu_error,
                 "backend_init_s": round(time.monotonic() - t_init, 1),
-                "tpu_env": tpu_env_details()}))
+                "tpu_env": tpu_env_details(),
+                "postmortem": tpu_pm}))
             sys.exit(1)
 
     import jax
@@ -538,6 +649,15 @@ def main():
                 args, delivery=ub["delivery"], fused=ub["pallas_fused"])
         except Exception as e:                   # noqa: BLE001
             tracing_block = {"error": str(e)}
+    # Metrics-export smoke (--metrics-smoke): the scrape-under-load
+    # round-trip record (PROFILE.md §11).
+    metrics_block = None
+    if args.metrics_smoke:
+        try:
+            metrics_block = bench_metrics_smoke(
+                args, delivery=ub["delivery"], fused=ub["pallas_fused"])
+        except Exception as e:                   # noqa: BLE001
+            metrics_block = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -582,9 +702,16 @@ def main():
     }
     if tracing_block is not None:
         result["tracing"] = tracing_block
+    if metrics_block is not None:
+        result["metrics"] = metrics_block
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error
         result["detail"]["tpu_env"] = tpu_env_details()
+        # CPU-fallback rounds carry the stall evidence (probe timeline
+        # + env snapshot) INSIDE the BENCH record, so a degraded round
+        # is diagnosable from the json alone:
+        #   python -m ponyc_tpu doctor --postmortem BENCH_rNN.json
+        result["postmortem"] = tpu_pm
     print(json.dumps(result))
 
 

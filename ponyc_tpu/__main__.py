@@ -49,6 +49,14 @@ Commands:
                               per-cohort queue-wait percentiles,
                               refreshed every --interval seconds
                               (--once renders a single frame).
+  doctor --postmortem FILE    render a flight-recorder postmortem
+  doctor <host:port|url>      (crash/SIGQUIT/watchdog dump, or the
+                              probe evidence in a BENCH json) or a
+                              live /metrics+/healthz endpoint
+                              (RuntimeOptions.metrics_port) into a
+                              one-line verdict + diagnosis. Exit:
+                              0 ok/snapshot, 1 stalled/crashed/
+                              degraded, 2 usage or unreadable.
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -438,6 +446,63 @@ def cmd_top(argv) -> int:
         return 0
 
 
+def cmd_doctor(argv) -> int:
+    """Operational diagnosis (PROFILE.md §11): read stall/crash
+    evidence and lead with a one-line verdict.
+
+        ponyc_tpu doctor --postmortem <file.postmortem.json|BENCH.json>
+        ponyc_tpu doctor <host:port | http://host:port>
+
+    The first form renders a flight-recorder postmortem (also accepts
+    a BENCH json whose `postmortem`/`tpu_init` evidence rides inside);
+    the second GETs /healthz + /metrics from a live runtime
+    (RuntimeOptions.metrics_port). Exit codes: 0 the world looks
+    healthy (ok / plain snapshot), 1 stalled/crashed/degraded, 2 usage
+    error or unreadable target."""
+    from .flight import diagnose_postmortem, load_postmortem
+    if "--postmortem" in argv:
+        i = argv.index("--postmortem")
+        if i + 1 >= len(argv):
+            print("ponyc_tpu doctor: --postmortem needs a file",
+                  file=sys.stderr)
+            return 2
+        path = argv[i + 1]
+        try:
+            pm = load_postmortem(path)
+        except (OSError, ValueError) as e:
+            # A BENCH json carries the probe postmortem nested under
+            # "postmortem" — accept the wrapper file directly.
+            import json as _json
+            try:
+                with open(path) as f:
+                    obj = _json.load(f)
+                pm = obj["postmortem"]
+                if not isinstance(pm, dict) or "reason" not in pm:
+                    raise KeyError("postmortem")
+            except (OSError, ValueError, KeyError, TypeError):
+                print(f"ponyc_tpu doctor: {e}", file=sys.stderr)
+                return 2
+        line, detail = diagnose_postmortem(pm)
+        print(line)
+        print(detail)
+        return 0 if line.startswith(("OK", "SNAPSHOT")) else 1
+    if not argv or argv[0].startswith("-"):
+        print("ponyc_tpu doctor: need --postmortem FILE or a live "
+              "host:port / URL (RuntimeOptions.metrics_port)",
+              file=sys.stderr)
+        return 2
+    from .metrics import diagnose_endpoint
+    try:
+        status, line, detail = diagnose_endpoint(argv[0])
+    except (OSError, ValueError) as e:
+        print(f"ponyc_tpu doctor: endpoint {argv[0]} unreachable: {e}",
+              file=sys.stderr)
+        return 2
+    print(line)
+    print(detail)
+    return 0 if status == "ok" else 1
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -453,7 +518,8 @@ def cmd_version(_argv) -> int:
 
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
             "doc": cmd_doc, "verify": cmd_verify, "lint": cmd_lint,
-            "trace": cmd_trace, "top": cmd_top, "version": cmd_version}
+            "trace": cmd_trace, "top": cmd_top, "doctor": cmd_doctor,
+            "version": cmd_version}
 
 
 def main(argv=None) -> int:

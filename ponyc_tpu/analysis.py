@@ -498,6 +498,52 @@ def attach(rt) -> Analysis:
     return a
 
 
+# ---- tolerant CSV reading (shared by chrome_trace and top_frame) ----
+#
+# A run killed mid-flush (crash, watchdog trip, kill -9) leaves the
+# window/event CSVs with a truncated final line; a run killed during
+# warmup leaves them header-only. Every reader parses what is whole and
+# warns ONCE per file per process instead of raising — crash artefacts
+# exist precisely to be read after ungraceful exits.
+
+_warned_truncated: set = set()
+
+
+def _warn_truncated(path: str, n: int) -> None:
+    if path in _warned_truncated:
+        return
+    _warned_truncated.add(path)
+    print(f"ponyc_tpu analysis: {path}: skipped {n} incomplete row(s) "
+          "(run killed mid-flush?)", file=sys.stderr)
+
+
+def _int0(v) -> int:
+    """Int of a CSV cell; 0 for missing/truncated/garbled cells."""
+    try:
+        return int(float(v)) if v not in (None, "") else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def _whole_rows(rows):
+    """Keep only whole rows: time_ms parses AND no trailing column is
+    missing (csv.DictReader fills short — truncated — lines with None).
+    Returns (rows, dropped)."""
+    ok = []
+    dropped = 0
+    for r in rows:
+        try:
+            float(r.get("time_ms") or "")
+        except (TypeError, ValueError):
+            dropped += 1
+            continue
+        if any(v is None for v in r.values()):
+            dropped += 1
+            continue
+        ok.append(r)
+    return ok, dropped
+
+
 def chrome_trace(csv_path: str, out_path: str,
                  events_path: Optional[str] = None,
                  spans_path: Optional[str] = None) -> str:
@@ -536,9 +582,16 @@ def chrome_trace(csv_path: str, out_path: str,
     ]
     with open(csv_path) as f:
         rows = list(_csv.DictReader(f))
+    # A run killed mid-flush leaves a truncated final row (and a
+    # killed-at-open run an empty file): parse what is whole, warn
+    # once, never raise (satellite fix — the postmortem workflow reads
+    # exactly these files after a crash).
+    rows, dropped = _whole_rows(rows)
+    if dropped:
+        _warn_truncated(csv_path, dropped)
     header = list(rows[0].keys()) if rows else []
     run_cols = [c for c in header if c and c.startswith("run:")
-                and any(int(r[c] or 0) for r in rows)]
+                and any(_int0(r.get(c)) for r in rows)]
     qw_cohorts = [c[5:] for c in header if c and c.startswith("qw50:")]
     for row in rows:
         ts = float(row["time_ms"]) * 1e3          # µs
@@ -554,18 +607,17 @@ def chrome_trace(csv_path: str, out_path: str,
                                "deadletter": "deadletter"})):
             out.append({"ph": "C", "pid": pid, "ts": ts,
                         "name": track,
-                        "args": {k: int(row[c])
+                        "args": {k: _int0(row.get(c))
                                  for k, c in cols.items()}})
         for c in run_cols:
             out.append({"ph": "C", "pid": pid, "ts": ts,
                         "name": f"behaviour {c[4:]}",
-                        "args": {"runs": int(row[c] or 0)}})
+                        "args": {"runs": _int0(row.get(c))}})
         for cn in qw_cohorts:
             out.append({"ph": "C", "pid": pid, "ts": ts,
                         "name": f"queue-wait {cn}",
-                        "args": {"p50": int(row.get(f"qw50:{cn}") or 0),
-                                 "p99": int(row.get(f"qw99:{cn}")
-                                            or 0)}})
+                        "args": {"p50": _int0(row.get(f"qw50:{cn}")),
+                                 "p99": _int0(row.get(f"qw99:{cn}"))}})
     if events_path is None:
         cand = csv_path + ".events.csv"
         events_path = cand if os.path.exists(cand) else None
@@ -573,14 +625,17 @@ def chrome_trace(csv_path: str, out_path: str,
         tids = {}
         evs = []
         with open(events_path) as f:
-            for row in _csv.DictReader(f):
-                name = row["event"]
-                tid = tids.setdefault(name, len(tids) + 1)
-                evs.append({"ph": "i", "pid": pid, "tid": tid, "s": "t",
-                            "ts": float(row["time_ms"]) * 1e3,
-                            "name": f"{name} a{row['actor']}",
-                            "args": {"actor": int(row["actor"]),
-                                     "step": int(row["step"])}})
+            ev_rows, ev_dropped = _whole_rows(list(_csv.DictReader(f)))
+        if ev_dropped:
+            _warn_truncated(events_path, ev_dropped)
+        for row in ev_rows:
+            name = row.get("event") or "?"
+            tid = tids.setdefault(name, len(tids) + 1)
+            evs.append({"ph": "i", "pid": pid, "tid": tid, "s": "t",
+                        "ts": float(row["time_ms"]) * 1e3,
+                        "name": f"{name} a{row.get('actor', '?')}",
+                        "args": {"actor": _int0(row.get("actor")),
+                                 "step": _int0(row.get("step"))}})
         # Metadata BEFORE the events they label: Perfetto resolves
         # track names on first sight of a tid (the satellite fix —
         # bare-pid tracks came from late/absent name records).
@@ -620,16 +675,10 @@ def top_frame(csv_path: str) -> str:
     # Satellite fix: a fresh run's CSV is empty or header-only until
     # the writer thread's first flush (analysis_flush_ms), and the
     # last row can be a half-written line mid-append — neither may
-    # crash the live view. Keep only rows whose time_ms parses; with
-    # none left, render a calm waiting frame instead.
-    ok_rows = []
-    for r in rows:
-        try:
-            float(r.get("time_ms") or "")
-        except (TypeError, ValueError):
-            continue
-        ok_rows.append(r)
-    rows = ok_rows
+    # crash the live view. Keep only whole rows (shared tolerant
+    # reader; `top` refreshes every interval, so no warning here);
+    # with none left, render a calm waiting frame instead.
+    rows, _dropped = _whole_rows(rows)
     if not rows:
         return (head + "\n(waiting for samples — no windows written "
                 "yet; is a runtime with analysis>=2 running?)")

@@ -199,6 +199,32 @@ class RuntimeOptions:
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)
 
+    # --- operational observability (flight recorder / stall watchdog /
+    # metrics export — PROFILE.md §11; ≙ the fork's always-on
+    # runtime-analysis posture). All three are HOST-side: none feeds the
+    # traced step, so with metrics_port=None and analysis=0 the step
+    # jaxpr is bit-identical to a build without them (tests assert). ---
+    flight_windows: int = 64       # flight-recorder ring: how many
+    #   retired-window records (control scalars the run loop already
+    #   fetched, controller decisions, GC stats, recent host mail) the
+    #   always-on black box retains for the crash/SIGQUIT/watchdog
+    #   postmortem (Runtime.stop(postmortem=True) dumps it on demand)
+    watchdog_s: Optional[float] = None  # stall-watchdog deadline in
+    #   seconds (None = off): a monitor thread trips when a run-loop
+    #   phase (backend init, a dispatched window, host work) makes no
+    #   progress stamp for this long — scaled up by the adaptive
+    #   controller's current window / initial window ratio so a
+    #   legitimately grown window is not misread as a stall. A trip
+    #   writes the flight-recorder postmortem and converts the silent
+    #   hang into an int-coded errors.PonyStallError
+    metrics_port: Optional[int] = None  # serve Prometheus text at
+    #   /metrics and a JSON health verdict at /healthz on
+    #   127.0.0.1:<port> via a stdlib-only HTTP thread (None = off,
+    #   0 = ephemeral port — read it back from rt._metrics.port).
+    #   Scrapes never touch the device: they render the snapshot the
+    #   run loop last pushed at a window boundary (the same
+    #   non-blocking posture as the analysis writer)
+
     # --- autotuning / caches (tuning.py; ≙ nothing in the reference —
     # its dispatch is one fixed O(1) switch, genfun.c; ours has
     # formulation choices whose winner is hardware- and shape-dependent,
@@ -278,6 +304,15 @@ class RuntimeOptions:
                 "trace_sample must be >= 0 (0 = off, N = 1-in-N)")
         if self.trace_slots < 1:
             raise ValueError("trace_slots must be >= 1")
+        if self.flight_windows < 1:
+            raise ValueError("flight_windows must be >= 1")
+        if self.watchdog_s is not None and not self.watchdog_s > 0:
+            raise ValueError("watchdog_s must be > 0 seconds (None = off)")
+        if self.metrics_port is not None \
+                and not 0 <= self.metrics_port < 65536:
+            raise ValueError(
+                "metrics_port must be in [0, 65535] (0 = ephemeral, "
+                "None = off)")
         if self.blob_slots < 0 or self.blob_words < 0:
             raise ValueError("blob_slots/blob_words must be >= 0")
         if (self.blob_slots > 0) != (self.blob_words > 0):
@@ -337,7 +372,7 @@ def _coerce(name: str, raw: str):
         return raw.lower() in ("1", "true", "yes", "on", "")
     if ty in ("int", int, "Optional[int]", Optional[int]):
         return int(raw)
-    if ty in ("float", float):
+    if ty in ("float", float, "Optional[float]", Optional[float]):
         return float(raw)
     return raw
 

@@ -42,6 +42,56 @@ def caller_loc(skip_pkg: bool = True) -> str:
     return "?"
 
 
+# --- stable runtime error codes (≙ the fork's int-coded errors made a
+# runtime-wide contract): every runtime error CLASS carries one fixed
+# int, exposed on the exception (`.code`), as the metrics label
+# `pony_tpu_errors_total{class=...,code=...}` (metrics.py) and in
+# flight-recorder postmortems (flight.py), so operators and alert rules
+# match on a number that never drifts with a message rewrite. The table
+# is documented in README "Operating it" — codes are append-only. ---
+ERROR_CODES = {
+    "PonyError": 1,           # behaviour-level error (default user code;
+    #   PonyError instances carry their own caller-chosen code)
+    "SpillOverflowError": 2,     # runtime.py — bounded spill exceeded
+    "SpawnCapacityError": 3,     # runtime.py — device spawn found no slot
+    "BlobCapacityError": 4,      # runtime.py — blob pool/budget exhausted
+    "CapabilityError": 5,        # hostmem.py — capability discipline
+    "VerifyError": 6,            # verify.py — behaviour budget violation
+    "PonyStallError": 7,         # this file — watchdog-declared stall
+}
+
+
+def error_code(exc) -> int:
+    """Stable int code of a runtime exception: the instance's own
+    `.code` when it carries one (PonyError), else the class table above
+    walked up the MRO; 0 = not a coded runtime error."""
+    code = getattr(exc, "code", None)
+    if isinstance(code, int):
+        return code
+    for klass in type(exc).__mro__:
+        c = ERROR_CODES.get(klass.__name__)
+        if c is not None:
+            return c
+    return 0
+
+
+class PonyStallError(RuntimeError):
+    """The stall watchdog (flight.py) declared the runtime wedged: a
+    run-loop phase (backend init, a dispatched window, host work)
+    exceeded its deadline with no progress stamp. Carries the tripped
+    phase and the postmortem path the watchdog wrote — the structured
+    replacement for the silent forever-hang (ISSUE 7 / the
+    `jax.devices()` init hang that degraded BENCH r03–r05)."""
+
+    code = ERROR_CODES["PonyStallError"]
+
+    def __init__(self, message: str = "", phase: str = "?",
+                 postmortem: str = ""):
+        super().__init__(message or f"runtime stalled in phase {phase!r}")
+        self.phase = phase
+        self.postmortem = postmortem
+
+
 class PonyError(Exception):
     """≙ pony_error_int: an error that is a value with an int code."""
 

@@ -35,11 +35,15 @@ from __future__ import annotations
 import sys
 from typing import Any, Dict, Set
 
+from .errors import ERROR_CODES
+
 
 class CapabilityError(TypeError):
     """A handle was used against its capability mode (≙ the compile
     errors cap.c/safeto.c raise; dynamic here because host code is
     Python)."""
+
+    code = ERROR_CODES["CapabilityError"]
 
 
 class HandleRef:

@@ -36,12 +36,19 @@ window of a concrete `quiesce_interval=N` — one code path either way.
 
 from __future__ import annotations
 
+import collections
+
 GROW_FACTOR = 2.0
 SHRINK_FACTOR = 0.5
 # Consecutive full-budget quiet windows at the SAME length before the
 # controller reports "steady" (it keeps growing before that; at hi the
 # count runs against the clamp).
 STEADY_AFTER = 3
+
+# Recent decisions kept for the flight-recorder postmortem (flight.py):
+# enough to show a shrink storm or oscillation around a stall without
+# growing with run length.
+RECENT_DECISIONS = 32
 
 
 class WindowController:
@@ -65,6 +72,10 @@ class WindowController:
         self.shrinks = 0
         self.holds = 0
         self._same = 0          # consecutive full-quiet windows here
+        # Bounded decision trail for postmortems: one small tuple per
+        # observe(), evicted FIFO — negligible against the window cost.
+        self.recent: collections.deque = collections.deque(
+            maxlen=RECENT_DECISIONS)
 
     def clamp(self, v: int) -> int:
         return min(self.hi, max(self.lo, int(v)))
@@ -104,7 +115,16 @@ class WindowController:
             if self._same >= STEADY_AFTER:
                 self.state = "steady"
         self.window = nxt
+        self.recent.append((int(ran), int(budget), bool(attention),
+                            int(qw_p99), nxt, self.state))
         return nxt
+
+    def recent_decisions(self) -> list:
+        """The bounded decision trail, newest last, as dicts — the
+        controller section of a flight-recorder postmortem."""
+        return [{"ran": r, "budget": b, "attention": a, "qw_p99": q,
+                 "window": w, "state": s}
+                for (r, b, a, q, w, s) in self.recent]
 
     def snapshot(self) -> dict:
         """Observable controller state (dump()/top/bench)."""

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..api import ActorTypeMeta, BehaviourDef
 from ..config import RuntimeOptions
-from ..errors import PonyError
+from ..errors import ERROR_CODES, PonyError, PonyStallError, error_code
 from ..ops import pack
 from ..program import Program
 from . import engine
@@ -48,6 +48,8 @@ WIN_BUCKETS = 16
 class SpillOverflowError(RuntimeError):
     """The bounded overflow spill was exceeded — raise mailbox_cap or
     spill_cap, or let backpressure mute faster (lower overload_threshold)."""
+
+    code = ERROR_CODES["SpillOverflowError"]
 
 
 class AmbientAuth:
@@ -70,11 +72,15 @@ class SpawnCapacityError(RuntimeError):
     none free — raise the target cohort's declared capacity (or let GC /
     destroy() return slots faster)."""
 
+    code = ERROR_CODES["SpawnCapacityError"]
+
 
 class BlobCapacityError(RuntimeError):
     """A device-side ctx.blob_alloc() wanted a pool slot but its window
     had none free — raise RuntimeOptions.blob_slots, or free blobs
     (ctx.blob_free) faster. ≙ pony_alloc exhausting the heap."""
+
+    code = ERROR_CODES["BlobCapacityError"]
 
 
 class HostContext:
@@ -246,6 +252,19 @@ class Runtime:
         self._rl_gap_ns = 0
         self._rl_requeued = 0
         self._win_hist = np.zeros((WIN_BUCKETS,), np.int64)
+        # ---- operational observability (PROFILE.md §11) ----
+        self._flight = None           # flight.FlightRecorder (start())
+        self._watchdog = None         # flight.Watchdog when watchdog_s
+        self._metrics = None          # metrics.MetricsServer when
+        #   metrics_port is not None
+        self._wd_epoch = 0            # phase-stamp progress counter
+        self._wd_stamp = ("idle", 0, time.monotonic())  # (phase,
+        #   epoch, t): one tuple assignment per transition — the cheap
+        #   progress evidence the watchdog thread reads
+        # Coded runtime errors raised/caught on this runtime, keyed
+        # (class_name, int code) — the errors.ERROR_CODES metrics label
+        # and the postmortem's error section.
+        self._error_counts: collections.Counter = collections.Counter()
 
     # Any state assignment — including a driver pushing rt._step results
     # back, as bench.py does — conservatively invalidates the cached
@@ -275,6 +294,36 @@ class Runtime:
         return self
 
     def start(self) -> "Runtime":
+        # ≙ pony_init, split so the operational pieces (the always-on
+        # flight recorder + optional stall watchdog, PROFILE.md §11)
+        # arm BEFORE the first device-touching call: a hung backend
+        # init (the jax.devices() wedge that silently degraded BENCH
+        # r03–r05 to CPU) then trips the watchdog — postmortem on disk,
+        # int-coded PonyStallError raised — instead of hanging forever.
+        self._apply_defaults_and_pin()
+        from .. import flight as _flight
+        self._flight = _flight.FlightRecorder(
+            self, self.opts.flight_windows)
+        self._stamp("backend-init")
+        if self.opts.watchdog_s is not None:
+            self._watchdog = _flight.Watchdog(self, self.opts.watchdog_s)
+            self._watchdog.start()
+        try:
+            self._start_world()
+        except KeyboardInterrupt:
+            stall = self._stall_from_interrupt()
+            if stall is not None:
+                raise stall from None
+            raise
+        if self.opts.metrics_port is not None:
+            from .. import metrics as _metrics
+            self._metrics = _metrics.MetricsServer(
+                self, self.opts.metrics_port)
+            self._metrics.update_now(self)
+        self._stamp("idle")
+        return self
+
+    def _apply_defaults_and_pin(self) -> None:
         # ≙ Main_runtime_override_defaults_oo (start.c:99,214): a declared
         # actor type may override runtime defaults — applied only when the
         # caller didn't pass explicit options (explicit flags win, exactly
@@ -297,6 +346,8 @@ class Runtime:
                 raise ValueError(
                     f"cannot pin host thread to core {self.opts.pin}: "
                     f"{e}") from None
+
+    def _start_world(self) -> None:
         # Persistent compile cache (tuning.enable_compile_cache): lands
         # before the first jit of this runtime so warm starts reload
         # executables instead of re-lowering (PROFILE.md §4b's 11.8 s).
@@ -369,7 +420,6 @@ class Runtime:
         for cohort in self.program.cohorts:
             self._free[cohort.atype.__name__] = list(
                 range(cohort.capacity - 1, -1, -1))
-        return self
 
     # ---- spawning (≙ pony_create, actor.c:688-734) ----
     def spawn(self, atype: ActorTypeMeta, **fields) -> int:
@@ -573,6 +623,10 @@ class Runtime:
         self.totals["gc_swept_blobs"] += int(n_swept)
         if not bool(converged):
             self.totals["gc_aborted"] += 1
+        if self._flight is not None:
+            self._flight.event("gc", collected=int(n), iters=int(iters),
+                               swept=int(n_swept),
+                               converged=bool(converged))
         # Growth-triggered accounting reset (≙ heap.c's next_gc update
         # after a collection) — here so every collection path, manual
         # included, clears the allocation-pressure signal consistently.
@@ -1092,6 +1146,10 @@ class Runtime:
                 if (pack.is_blob(spec) and not pack.is_blob_val(spec)
                         and int(a) >= 0):
                     self._host_blobs.add(int(a))
+        if self._flight is not None:
+            # Recent-host-mail lane of the black box (bounded ring).
+            self._flight.mail(aid, f"{cohort.atype.__name__}."
+                                   f"{bdef.name}")
         try:
             st2 = bdef.fn(ctx, st, *args)
         except PonyError as e:
@@ -1100,6 +1158,7 @@ class Runtime:
             self._host_errors[aid] = e.code
             self._host_error_locs[aid] = e.loc
             self.totals["host_errors"] += 1
+            self._error_counts[("PonyError", e.code)] += 1
             st2 = st
         self._host_state[aid] = st2 if st2 is not None else st
         self.totals["host_processed"] += 1
@@ -1166,6 +1225,40 @@ class Runtime:
     # (runtime/controller.py): grow on full-budget quiet windows,
     # shrink on host-attention cuts and queue-wait p99 pressure.
 
+    def _stamp(self, phase: str) -> None:
+        """Advance the watchdog phase stamp (flight.py): one int bump +
+        one tuple assignment, readable atomically from any thread. The
+        run loop stamps every phase transition (dispatching / in-flight
+        / host-work / quiescent / idle), so 'no stamp within the
+        deadline' is exactly 'no progress'."""
+        self._wd_epoch += 1
+        self._wd_stamp = (phase, self._wd_epoch, time.monotonic())
+
+    def _fatal(self, exc):
+        """Record a coded runtime error (metrics label + postmortem
+        evidence) on its way out; returns `exc` so raise sites stay
+        one-liners."""
+        self._error_counts[(type(exc).__name__, error_code(exc))] += 1
+        if self._flight is not None:
+            self._flight.event("error", cls=type(exc).__name__,
+                               code=error_code(exc), message=str(exc))
+        return exc
+
+    def _stall_from_interrupt(self):
+        """A pending KeyboardInterrupt may be the watchdog's doing
+        (flight.Watchdog.trip interrupts the main thread after dumping
+        the postmortem): convert it to the int-coded stall error, or
+        return None for a genuine Ctrl-C."""
+        wd = self._watchdog
+        if wd is None or wd.tripped is None:
+            return None
+        t = wd.tripped
+        return self._fatal(PonyStallError(
+            f"runtime stalled: phase {t['phase']!r} made no progress "
+            f"for {t['age_s']}s (deadline {t['deadline_s']}s; "
+            f"postmortem: {t.get('postmortem') or '(unwritten)'})",
+            phase=t["phase"], postmortem=t.get("postmortem", "")))
+
     def _defer_signals(self):
         """Block SIGINT/SIGTERM delivery across the donation-critical
         dispatch region: `self._multi_g` consumes (donates) the current
@@ -1206,6 +1299,7 @@ class Runtime:
             gap_ns = 0 if self._last_retire_t is None else \
                 max(0, int((now - self._last_retire_t) * 1e9))
         inj_t, inj_w, consumed = self._drain_inject_tracked()
+        self._stamp("dispatching")
         mask = self._defer_signals()
         try:
             st2, aux, kdev = self._multi_g(
@@ -1215,6 +1309,9 @@ class Runtime:
             epoch = self._state_epoch
         finally:
             self._restore_signals(mask)
+        # From here the window is the device's: the watchdog deadline
+        # now covers device completion, not host dispatch latency.
+        self._stamp("in-flight")
         # Start the device→host DMA of the control scalars now; the
         # retire's device_get then waits on data already in motion
         # instead of issuing the request after the window completes.
@@ -1224,7 +1321,8 @@ class Runtime:
             except AttributeError:
                 pass
         return {"aux": aux, "k": kdev, "budget": int(budget),
-                "consumed": consumed, "gap_ns": gap_ns, "epoch": epoch}
+                "consumed": consumed, "gap_ns": gap_ns, "epoch": epoch,
+                "pipelined": pipelined}
 
     def _retire_window(self, win: Dict[str, Any]):
         """Fetch an in-flight window's (ticks_run, aux) and fold it into
@@ -1234,6 +1332,9 @@ class Runtime:
         host scalars)."""
         k, a = jax.device_get((win["k"], win["aux"]))
         self._last_retire_t = time.perf_counter()
+        # The fetch returned: the device answered, the host boundary
+        # work for this window starts now (watchdog phase evidence).
+        self._stamp("host-work")
         k = int(k)
         if k == 0:
             if win["consumed"]:
@@ -1269,32 +1370,41 @@ class Runtime:
             or bool(a.blob_fail) or bool(a.blob_budget_fail)
         self._controller.observe(k, win["budget"], attention,
                                  qw_p99=int(a.qw_p99))
+        # Flight recorder (PROFILE.md §11): the black box retains this
+        # window's already-fetched control scalars — host ints only,
+        # one bounded-deque append; no extra device traffic.
+        if self._flight is not None:
+            self._flight.window(self.steps_run, k, win["budget"],
+                                win["gap_ns"] / 1e3,
+                                win.get("pipelined", False), a)
         if getattr(self, "_analysis", None) is not None:
             self._analysis.window(a, ticks=k,
                                   gap_us=win["gap_ns"] / 1e3)
+        if self._metrics is not None:
+            self._metrics.maybe_update(self)
         return k, a
 
     def _fatal_checks(self, a) -> None:
         if bool(a.spill_overflow):
-            raise SpillOverflowError(
-                f"spill overflow at step {self.steps_run}")
+            raise self._fatal(SpillOverflowError(
+                f"spill overflow at step {self.steps_run}"))
         if bool(a.spawn_fail):
-            raise SpawnCapacityError(
+            raise self._fatal(SpawnCapacityError(
                 f"device spawn found no free slot by step "
-                f"{self.steps_run}")
+                f"{self.steps_run}"))
         if bool(a.blob_fail):
-            raise BlobCapacityError(
+            raise self._fatal(BlobCapacityError(
                 f"device blob_alloc found no free pool slot by step "
                 f"{self.steps_run} — the pool is exhausted: raise "
                 "RuntimeOptions.blob_slots, or free blobs "
-                "(ctx.blob_free) faster")
+                "(ctx.blob_free) faster"))
         if bool(a.blob_budget_fail):
-            raise BlobCapacityError(
+            raise self._fatal(BlobCapacityError(
                 f"device blob_alloc exceeded its per-tick reservation "
                 f"budget by step {self.steps_run} — more allocating "
                 "dispatches than BLOB_DISPATCHES in one tick (free "
                 "pool slots may remain): raise the actor class's "
-                "BLOB_DISPATCHES (or lower its batch)")
+                "BLOB_DISPATCHES (or lower its batch)"))
 
     @staticmethod
     def _clean_busy(a) -> bool:
@@ -1325,6 +1435,17 @@ class Runtime:
         a = None          # newest RETIRED aux; None forces a first window
         win = None        # the one in-flight (unretired) window
         self._last_retire_t = None
+        # SIGQUIT = dump the flight recorder and keep running (the
+        # operator's "what is it doing RIGHT NOW" key, ^\ on a tty;
+        # SIGTERM/SIGUSR1 stay the analysis dump's, PROFILE.md §8).
+        prev_quit = None
+        if self._flight is not None and hasattr(signal, "SIGQUIT"):
+            def _quit_dump(_signum, _frame):
+                self._flight.dump(reason="SIGQUIT")
+            try:
+                prev_quit = signal.signal(signal.SIGQUIT, _quit_dump)
+            except ValueError:      # not the main thread: skip
+                prev_quit = None
         try:
             while True:
                 if win is None:
@@ -1383,6 +1504,7 @@ class Runtime:
                     win = spec
                 # ---- host boundary for `a` (overlaps `win`'s device
                 # execution when the pipeline kept one in flight) ----
+                self._stamp("host-work")
                 self._fatal_checks(a)
                 if bool(a.exit_flag):
                     self._exit_code = int(a.exit_code)
@@ -1483,6 +1605,10 @@ class Runtime:
                     # completions).
                     waiter = next((p for p in self._bridge_pollers
                                    if hasattr(p, "wait")), None)
+                    # Waiting on the outside world is a HEALTHY steady
+                    # state: the watchdog disarms on this phase (a
+                    # quiet timer-driven service is not a stall).
+                    self._stamp("quiescent")
                     if waiter is not None:
                         waiter.wait(0.02)
                     else:
@@ -1493,6 +1619,14 @@ class Runtime:
                 if max_steps is not None \
                         and steps_this_run + skipped_boundaries >= max_steps:
                     break
+        except KeyboardInterrupt:
+            # The interrupt may be the watchdog's (flight.Watchdog
+            # trips by signalling the main thread after dumping the
+            # postmortem): surface the int-coded stall, not a bare ^C.
+            stall = self._stall_from_interrupt()
+            if stall is not None:
+                raise stall from None
+            raise
         finally:
             # Interrupt safety (KeyboardInterrupt/SIGTERM mid-pipeline,
             # and every fatal raise above): an in-flight window's output
@@ -1501,20 +1635,49 @@ class Runtime:
             # run loses no host-outbox messages and the runtime stays
             # consistent for a restart (no donated-buffer reuse).
             import sys as _sys
-            if win is not None:
+            # A tripped watchdog means the device (or a host phase) is
+            # WEDGED: retiring the in-flight window or refreshing the
+            # metrics snapshot would block on the very hang we are
+            # converting to an error — skip device-touching teardown
+            # and let the PonyStallError out (the runtime is not
+            # restartable after a stall; the postmortem is the value).
+            stalled = (self._watchdog is not None
+                       and self._watchdog.tripped is not None)
+            if win is not None and not stalled:
                 k2, a2 = self._retire_window(win)
                 steps_this_run += k2
                 if bool(a2.host_pending):
                     self._drain_host()
-            if _sys.exc_info()[0] is not None:
+            if _sys.exc_info()[0] is not None \
+                    and not isinstance(_sys.exc_info()[1], PonyStallError):
                 # Interrupted between boundaries: host→host messages
                 # already queued on the fast lane would otherwise be
                 # stranded until the next run() — deliver them now
                 # (bounded by the normal per-boundary budget). Normal
                 # exits skip this: quiescent termination proves the
                 # lane empty, and an exit() break stops the world as
-                # the synchronous loop always has.
+                # the synchronous loop always has. A watchdog STALL
+                # also skips it: the wedged behaviour may be ON this
+                # lane, and re-dispatching it would hang the unwind.
                 self._drain_host_fast(self.opts.host_fastpath_budget)
+            if prev_quit is not None:
+                try:
+                    signal.signal(signal.SIGQUIT, prev_quit)
+                except ValueError:
+                    pass
+            self._stamp("idle")
+            # Crash postmortem (PROFILE.md §11): any exceptional exit
+            # dumps the black box. Stall trips already dumped (the
+            # watchdog thread wrote it before interrupting us).
+            exc = _sys.exc_info()[1]
+            if (exc is not None and self._flight is not None
+                    and not isinstance(exc, (SystemExit,
+                                             PonyStallError))):
+                self._flight.dump(
+                    reason=f"crash: {type(exc).__name__}: {exc}",
+                    error_code=error_code(exc))
+            if self._metrics is not None and not stalled:
+                self._metrics.update_now(self)
         # Persist a converged adaptive window for warm starts (PR 1
         # tuning-cache machinery): only a steady controller with real
         # evidence writes, and only when the value actually moved.
@@ -1554,9 +1717,14 @@ class Runtime:
         self._exit_code = int(code)
         self._exit_requested = True
 
-    def stop(self) -> int:
+    def stop(self, postmortem: bool = False) -> int:
         """Tear down auxiliaries (≙ pony_stop, start.c:332-351): emit the
-        analysis summary, stop the writer thread, close the bridge."""
+        analysis summary, stop the writer thread, close the bridge, and
+        stop the watchdog/metrics threads. ``postmortem=True``
+        additionally dumps the flight recorder (the on-demand black-box
+        read — path lands in ``rt._flight.last_dump``)."""
+        if postmortem and self._flight is not None:
+            self._flight.dump(reason="stop(postmortem=True)")
         a = getattr(self, "_analysis", None)
         if a is not None:
             a.summary()
@@ -1568,6 +1736,16 @@ class Runtime:
             self.bridge = None
             self._bridge_pollers = [p for p in self._bridge_pollers
                                     if p is not b]
+        wd = self._watchdog
+        if wd is not None:
+            wd.close()
+            self._watchdog = None
+        if self._metrics is not None:
+            if wd is None or wd.tripped is None:
+                # A stalled device would hang this last snapshot fetch.
+                self._metrics.update_now(self)
+            self._metrics.close()
+            self._metrics = None
         return self._exit_code
 
     # ---- introspection (≙ ponyint_actor_num_messages, actor.c:666; and

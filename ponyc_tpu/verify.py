@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .api import ActorTypeMeta, BehaviourDef, Context
+from .errors import ERROR_CODES
 from .ops import pack
 
 
@@ -106,6 +107,8 @@ class Effects:
 class VerifyError(TypeError):
     """A behaviour violates its type's declared budgets (≙ the verify
     pass rejecting a method body, verify/fun.c)."""
+
+    code = ERROR_CODES["VerifyError"]
 
 
 def behaviour_location(bdef: BehaviourDef

@@ -95,6 +95,19 @@ def test_bench_trace_smoke_block(bench_mod):
     assert t["analysis"] == 3 and t["trace_sample"] == 1
 
 
+def test_bench_metrics_smoke_block(bench_mod):
+    """The --metrics-smoke `metrics` block (PROFILE.md §11): a real
+    HTTP scrape-under-load round-trip — /healthz answers mid-run and
+    the final Prometheus counters equal Runtime.profile()."""
+    m = bench_mod.bench_metrics_smoke(_args(), delivery="plan",
+                                      fused=False)
+    assert m["scrape_ok"], m
+    assert m["counters_match"], m
+    assert m["live_scrapes"] >= 1
+    assert m["final_status"] == "ok"
+    assert m["port"] == 0                # ephemeral requested
+
+
 def test_tpu_env_details_shape(bench_mod):
     """The tpu_init_error env snapshot: JSON-serialisable, secrets
     filtered, libtpu presence probed."""
@@ -103,6 +116,23 @@ def test_tpu_env_details_shape(bench_mod):
     _json.dumps(d)                       # must serialise
     assert "libtpu_importable" in d
     assert all("KEY" not in k and "TOKEN" not in k for k in d["env"])
+
+
+def test_tpu_init_postmortem_embeds_and_diagnoses(bench_mod, capsys):
+    """On tpu_init_error the BENCH json carries the flight-recorder
+    postmortem (probe timeline + env snapshot) and the doctor's
+    one-line diagnosis lands on stderr — CPU-fallback rounds carry
+    their stall evidence."""
+    import json as _json
+    tl = [{"attempt": 1, "timeout_s": 180.0, "t_s": 181.0,
+           "error": "jax.devices() did not return within 180s"}]
+    pm = bench_mod.tpu_init_postmortem(tl)
+    _json.dumps(pm)                      # BENCH json embeddable
+    assert pm["reason"] == "tpu_init_failed"
+    assert pm["probe_timeline"] == tl
+    assert "libtpu_importable" in pm["env"]
+    err = capsys.readouterr().err
+    assert "doctor: STALLED: TPU backend init failed" in err
 
 
 def test_tristate_parsing(bench_mod):

@@ -1,0 +1,414 @@
+"""Flight recorder + stall watchdog tests (PROFILE.md §11): the
+always-on bounded black box, postmortem dumps on stop/crash/SIGQUIT,
+the watchdog converting a deliberately wedged run into a structured
+postmortem + int-coded PonyStallError, stable error codes, and the
+`doctor --postmortem` CLI."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu import flight
+from ponyc_tpu.errors import ERROR_CODES, PonyError, PonyStallError, \
+    error_code
+from ponyc_tpu.models import ring
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _opts(**kw):
+    base = dict(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                spill_cap=64, inject_slots=8)
+    base.update(kw)
+    return RuntimeOptions(**base)
+
+
+# ------------------------------------------------------ recorder basics
+
+def test_recorder_always_on_and_bounded(tmp_path):
+    """The black box exists on every runtime (no opt-in), records one
+    entry per retired window, and its rings stay bounded."""
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(flight_windows=4, analysis_path=path))
+    assert rt._flight is not None           # always-on
+    rt.send(int(ids[0]), ring.RingNode.token, 200)
+    rt.run()
+    fr = rt._flight
+    assert 1 <= len(fr.windows) <= 4        # bounded by flight_windows
+    w = fr.windows[-1]
+    assert set(w) >= {"t_ms", "step", "ticks", "budget", "gap_us",
+                      "pipelined", "processed", "delivered", "occ_sum",
+                      "occ_max", "qw_p99", "flags"}
+    assert w["flags"]["exit"]               # ring exits at hops==1
+    assert w["processed"] == 200
+    rt.stop()
+
+
+def test_recorder_gc_events_and_host_mail(tmp_path):
+    @actor
+    class HostEcho:
+        n: I32
+        HOST = True
+
+        @behaviour
+        def ping(self, st, v: I32):
+            return {**st, "n": st["n"] + v}
+
+    rt = Runtime(_opts(analysis_path=str(tmp_path / "an.csv")))
+    rt.declare(HostEcho, 2).start()
+    h = rt.spawn(HostEcho)
+    rt.send(h, HostEcho.ping, 3)
+    rt.run()
+    rt.release([h])
+    rt.gc()
+    fr = rt._flight
+    kinds = [e["kind"] for e in fr.events]
+    assert "gc" in kinds
+    assert any(m["behaviour"] == "HostEcho.ping" for m in fr.host_mail)
+    rt.stop()
+
+
+def test_stop_postmortem_dump_roundtrip(tmp_path, capfd):
+    """Runtime.stop(postmortem=True) writes a valid structured dump
+    (atomic .postmortem.json) and prints the human text; the file
+    loads back through the doctor's reader."""
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(analysis_path=path))
+    rt.send(int(ids[0]), ring.RingNode.token, 30)
+    rt.run()
+    rt.stop(postmortem=True)
+    pm_path = path + ".postmortem.json"
+    assert rt._flight.last_dump == pm_path
+    pm = flight.load_postmortem(pm_path)
+    assert pm["version"] == flight.POSTMORTEM_VERSION
+    assert pm["reason"].startswith("stop")
+    assert pm["steps_run"] == rt.steps_run
+    assert pm["windows"] and pm["options"]["mailbox_cap"] == 8
+    assert pm["phase"]["name"] == "idle"
+    err = capfd.readouterr().err
+    assert "flight-recorder postmortem" in err
+    line, detail = flight.diagnose_postmortem(pm)
+    assert line.startswith("SNAPSHOT")
+    assert "windows" in detail
+
+
+def test_crash_dump_on_fatal_run_error(tmp_path):
+    """Any exceptional run() exit dumps the black box with the reason
+    and the coded-error evidence."""
+
+    @actor
+    class Bad:
+        n: I32
+        HOST = True
+
+        @behaviour
+        def boom(self, st, v: I32):
+            raise ValueError("kaboom")
+
+    path = str(tmp_path / "an.csv")
+    rt = Runtime(_opts(analysis_path=path))
+    rt.declare(Bad, 2).start()
+    b = rt.spawn(Bad)
+    rt.send(b, Bad.boom, 1)
+    with pytest.raises(ValueError, match="kaboom"):
+        rt.run()
+    pm = flight.load_postmortem(path + ".postmortem.json")
+    assert pm["reason"].startswith("crash: ValueError")
+    line, _ = flight.diagnose_postmortem(pm)
+    assert line.startswith("CRASHED")
+
+
+# ---------------------------------------------------------- error codes
+
+def test_error_code_table_is_stable():
+    """The code table is operational API (metrics labels, postmortems,
+    alert rules): pin it."""
+    assert ERROR_CODES == {
+        "PonyError": 1, "SpillOverflowError": 2,
+        "SpawnCapacityError": 3, "BlobCapacityError": 4,
+        "CapabilityError": 5, "VerifyError": 6, "PonyStallError": 7}
+
+
+def test_error_classes_expose_codes():
+    from ponyc_tpu.hostmem import CapabilityError
+    from ponyc_tpu.runtime.runtime import (BlobCapacityError,
+                                           SpawnCapacityError,
+                                           SpillOverflowError)
+    from ponyc_tpu.verify import VerifyError
+    assert SpillOverflowError.code == 2
+    assert SpawnCapacityError.code == 3
+    assert BlobCapacityError.code == 4
+    assert CapabilityError.code == 5
+    assert VerifyError.code == 6
+    assert PonyStallError.code == 7
+    assert error_code(SpillOverflowError("x")) == 2
+    assert error_code(PonyError(42)) == 42        # instance code wins
+    assert error_code(PonyError()) == 1
+    assert error_code(ValueError("x")) == 0       # not a runtime error
+
+
+def test_fatal_errors_count_for_metrics(tmp_path):
+    """A fatal aux flag raise lands in rt._error_counts — the
+    pony_tpu_errors_total{class=,code=} label source."""
+    from ponyc_tpu.runtime.engine import zero_aux
+    rt, _ids = ring.build(8, _opts(analysis_path=str(tmp_path / "a.csv")))
+    from ponyc_tpu.runtime.runtime import SpillOverflowError
+    a = zero_aux()._replace(spill_overflow=True)
+    with pytest.raises(SpillOverflowError):
+        rt._fatal_checks(a)
+    assert rt._error_counts[("SpillOverflowError", 2)] == 1
+    assert any(e["kind"] == "error" for e in rt._flight.events)
+    rt.stop()
+
+
+# ------------------------------------------------------------- watchdog
+
+def test_watchdog_check_pure():
+    """Deadline evaluation against synthetic phase stamps: armed phases
+    trip past the (scaled) deadline, healthy phases never do."""
+    rt, _ids = ring.build(8, _opts(watchdog_s=1.0))
+    wd = rt._watchdog
+    try:
+        now = time.monotonic()
+        # warm runtime: flush the cold-phase grace
+        rt._rl_windows = 5
+        rt._wd_stamp = ("host-work", 7, now - 0.5)
+        assert wd.check(now) is None            # within deadline
+        rt._wd_stamp = ("host-work", 8, now - 1.5)
+        trip = wd.check(now)
+        assert trip is not None and trip["phase"] == "host-work"
+        assert trip["age_s"] >= 1.5 and trip["deadline_s"] == 1.0
+        # quiescent/idle never trip, however old the stamp
+        for phase in ("quiescent", "idle"):
+            rt._wd_stamp = (phase, 9, now - 1e6)
+            assert wd.check(now) is None
+        # controller growth scales the deadline (window 4x initial)
+        rt._wd_stamp = ("in-flight", 10, now - 1.5)
+        rt._controller.window = rt._qi_loaded * 4
+        assert wd.check(now) is None            # 4x deadline now
+        rt._wd_stamp = ("in-flight", 11, now - 4.5)
+        assert wd.check(now) is not None
+    finally:
+        rt.stop()
+
+
+def test_watchdog_cold_phase_grace():
+    """The first window's trace+compile must not read as a stall: cold
+    device phases get COLD_FACTOR x deadline."""
+    rt, _ids = ring.build(8, _opts(watchdog_s=1.0))
+    wd = rt._watchdog
+    try:
+        now = time.monotonic()
+        assert rt._rl_windows == 0              # nothing retired yet
+        rt._wd_stamp = ("dispatching", 1, now - 2.0)
+        assert wd.check(now) is None            # < 10s cold deadline
+        rt._wd_stamp = ("dispatching", 2, now - 11.0)
+        assert wd.check(now) is not None        # even cold has a limit
+        # host-work never gets the cold grace (no compile there)
+        rt._wd_stamp = ("host-work", 3, now - 2.0)
+        assert wd.check(now) is not None
+    finally:
+        rt.stop()
+
+
+def test_watchdog_quiet_run_never_trips(tmp_path):
+    """A normal run with a tight-ish deadline completes untripped."""
+    rt, ids = ring.build(8, _opts(watchdog_s=5.0,
+                                  analysis_path=str(tmp_path / "a.csv")))
+    rt.send(int(ids[0]), ring.RingNode.token, 50)
+    assert rt.run() == 0
+    assert rt._watchdog.tripped is None
+    rt.stop()
+    assert rt._watchdog is None                 # stop() reaps the thread
+
+
+STALL_SCRIPT = """
+import json, sys, time
+sys.path.insert(0, {root!r})
+from ponyc_tpu.platforms import force_cpu
+force_cpu()
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+from ponyc_tpu.errors import PonyStallError
+
+@actor
+class Wedge:
+    n: I32
+    HOST = True
+
+    @behaviour
+    def jam(self, st, v: I32):
+        time.sleep(600)            # the deliberate stall
+        return st
+
+rt = Runtime(RuntimeOptions(
+    mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+    watchdog_s=0.6, analysis_path={apath!r}))
+rt.declare(Wedge, 2).start()
+w = rt.spawn(Wedge)
+rt.send(w, Wedge.jam, 1)
+t0 = time.monotonic()
+try:
+    rt.run()
+    print("NO-RAISE")
+except PonyStallError as e:
+    print(json.dumps({{"code": e.code, "phase": e.phase,
+                      "postmortem": e.postmortem,
+                      "elapsed_s": round(time.monotonic() - t0, 1)}}))
+    sys.exit(42)
+"""
+
+
+def test_watchdog_trips_wedged_run_subprocess(tmp_path):
+    """ACCEPTANCE: a deliberately wedged run is converted by the
+    watchdog into a structured postmortem + int-coded PonyStallError
+    within the deadline — instead of the silent forever-hang."""
+    apath = str(tmp_path / "stall.csv")
+    code = STALL_SCRIPT.format(root=ROOT, apath=apath)
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 42, (p.returncode, p.stdout, p.stderr)
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["code"] == ERROR_CODES["PonyStallError"] == 7
+    assert out["phase"] == "host-work"
+    # "within the deadline": the stall lasted 600s, the conversion took
+    # seconds (deadline 0.6s + trip poll + signal delivery + unwind).
+    assert out["elapsed_s"] < 60
+    # The watchdog's postmortem is on disk and structurally valid.
+    pm = flight.load_postmortem(out["postmortem"])
+    assert pm["reason"].startswith("watchdog")
+    assert pm["watchdog"]["tripped"]["phase"] == "host-work"
+    assert any(e["kind"] == "watchdog_trip" for e in pm["events"])
+    line, _ = flight.diagnose_postmortem(pm)
+    assert line.startswith("STALLED")
+    assert "host behaviour" in line            # the phase hint
+    assert "STALLED" in p.stderr               # loud on the way down
+
+
+SIGQUIT_SCRIPT = """
+import os, signal, sys
+sys.path.insert(0, {root!r})
+from ponyc_tpu.platforms import force_cpu
+force_cpu()
+from ponyc_tpu import I32, Runtime, RuntimeOptions, actor, behaviour
+
+@actor
+class Poker:
+    n: I32
+    HOST = True
+
+    @behaviour
+    def poke(self, st, v: I32):
+        os.kill(os.getpid(), signal.SIGQUIT)   # operator hits ^\\
+        self.exit(0, when=v <= 0)
+        self.send(self.actor_id, Poker.poke, v - 1, when=v > 0)
+        return st
+
+rt = Runtime(RuntimeOptions(
+    mailbox_cap=8, batch=1, max_sends=2, msg_words=1,
+    analysis_path={apath!r}))
+rt.declare(Poker, 2).start()
+p = rt.spawn(Poker)
+rt.send(p, Poker.poke, 2)
+code = rt.run()
+print("EXIT", code, "DUMPS", rt._flight.dumps)
+sys.exit(code)
+"""
+
+
+def test_sigquit_dumps_and_continues(tmp_path):
+    """SIGQUIT mid-run dumps the flight recorder and the run carries on
+    to its normal exit (dump-and-continue, unlike SIGTERM)."""
+    apath = str(tmp_path / "sq.csv")
+    code = SIGQUIT_SCRIPT.format(root=ROOT, apath=apath)
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, (p.returncode, p.stdout, p.stderr)
+    assert "EXIT 0" in p.stdout
+    assert "DUMPS 3" in p.stdout               # one per SIGQUIT
+    pm = flight.load_postmortem(apath + ".postmortem.json")
+    assert pm["reason"] == "SIGQUIT"
+    assert "flight-recorder postmortem" in p.stderr
+
+
+# -------------------------------------------------- probe postmortems
+
+def test_probe_postmortem_and_diagnosis():
+    """The backend-init evidence bench.py embeds on tpu_init_error."""
+    tl = [{"attempt": 1, "timeout_s": 180.0, "t_s": 180.2,
+           "error": "jax.devices() did not return within 180s "
+                    "(backend init hang)"},
+          {"attempt": 2, "timeout_s": 300.0, "t_s": 12.0,
+           "error": "probe exited rc=1"}]
+    pm = flight.probe_postmortem(tl, {"env": {}, "libtpu_importable": False})
+    json.dumps(pm)                              # must serialise
+    assert pm["reason"] == "tpu_init_failed"
+    assert pm["phase"]["name"] == "backend-init"
+    assert pm["probe_timeline"] == tl
+    line, detail = flight.diagnose_postmortem(pm)
+    assert line.startswith("STALLED: TPU backend init failed after 2")
+    assert "probe exited rc=1" in line
+    assert "attempt 1" in detail
+
+
+# ----------------------------------------------------------- doctor CLI
+
+def test_doctor_cli_postmortem(tmp_path, capsys):
+    from ponyc_tpu.__main__ import main as cli_main
+    path = str(tmp_path / "an.csv")
+    rt, ids = ring.build(8, _opts(analysis_path=path))
+    rt.send(int(ids[0]), ring.RingNode.token, 20)
+    rt.run()
+    rt.stop(postmortem=True)
+    capsys.readouterr()
+    # A plain snapshot diagnoses healthy (exit 0).
+    assert cli_main(["doctor", "--postmortem",
+                     path + ".postmortem.json"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("SNAPSHOT")
+    assert "flight-recorder postmortem" in out
+    # A stall postmortem exits 1.
+    stall = rt._flight.postmortem("watchdog: phase 'in-flight' made no "
+                                  "progress for 9.0s (deadline 3.0s)")
+    spath = str(tmp_path / "stall.json")
+    json.dump(stall, open(spath, "w"))
+    assert cli_main(["doctor", "--postmortem", spath]) == 1
+    assert capsys.readouterr().out.startswith("STALLED")
+
+
+def test_doctor_cli_bench_json_wrapper(tmp_path, capsys):
+    """`doctor --postmortem BENCH.json` reads the nested probe
+    evidence a CPU-fallback bench round embeds."""
+    from ponyc_tpu.__main__ import main as cli_main
+    tl = [{"attempt": 1, "timeout_s": 60.0, "t_s": 60.0,
+           "error": "backend init hang"}]
+    bench_json = {"metric": "x", "value": 1,
+                  "postmortem": flight.probe_postmortem(tl, {"env": {}})}
+    path = str(tmp_path / "BENCH_r99.json")
+    json.dump(bench_json, open(path, "w"))
+    assert cli_main(["doctor", "--postmortem", path]) == 1
+    assert "TPU backend init failed" in capsys.readouterr().out
+
+
+def test_doctor_cli_usage_errors(tmp_path):
+    from ponyc_tpu.__main__ import main as cli_main
+    assert cli_main(["doctor"]) == 2                    # no target
+    assert cli_main(["doctor", "--postmortem"]) == 2    # missing file
+    assert cli_main(["doctor", "--postmortem",
+                     str(tmp_path / "absent.json")]) == 2
+    bad = str(tmp_path / "bad.json")
+    open(bad, "w").write("{}")
+    assert cli_main(["doctor", "--postmortem", bad]) == 2
+
+
+def test_watchdog_option_validation():
+    with pytest.raises(ValueError, match="watchdog_s"):
+        RuntimeOptions(watchdog_s=0.0)
+    with pytest.raises(ValueError, match="flight_windows"):
+        RuntimeOptions(flight_windows=0)
