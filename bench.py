@@ -47,13 +47,17 @@ went, not just totals. The timed headline pass itself stays level 0.
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
                         [--delivery auto|plan|cosort] [--fused auto|on|off]
                         [--trace-smoke] [--metrics-smoke]
+                        [--checkpoint-smoke]
 
 --trace-smoke adds a `tracing` block: one sampled causal-tracing pass
 (analysis=3, trace_sample=1, PROFILE.md §10) reassembled and checked
 (spans_ok/span_count_ok — attribution_ok style). --metrics-smoke adds
 a `metrics` block: a scrape-under-load round-trip through the real
 HTTP exporter (RuntimeOptions.metrics_port, PROFILE.md §11) whose
-final counters must equal Runtime.profile(). Every run records
+final counters must equal Runtime.profile(). --checkpoint-smoke adds
+a `checkpoint` block: checkpoint cost per window, per-checkpoint
+capture/write costs and restore-fast-start time (durable worlds,
+PROFILE.md §12). Every run records
 `backend_init_s`, and a failed TPU init — including --platform tpu,
 which now probes in a subprocess instead of hanging in-process — emits
 an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`)
@@ -444,6 +448,98 @@ def bench_metrics_smoke(args, delivery="plan", fused=False):
     }
 
 
+def bench_checkpoint_smoke(args, delivery="plan", fused=False):
+    """Durable-worlds smoke (PROFILE.md §12; --checkpoint-smoke): the
+    standing record of what crash-safe checkpointing costs and buys on
+    this platform — (a) steady-state overhead of a cadence-checkpointed
+    run vs the same run with checkpointing off (µs/window), (b) the
+    per-checkpoint capture (run-loop-blocking) and write (background)
+    costs from Runtime.checkpoint_stats(), (c) restore-fast-start: time
+    to restore the soaked terminal world into a fresh runtime, with the
+    outcome asserted equal. Bounded world; never sinks a headline run
+    (main() guards with try/except)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from ponyc_tpu import Runtime, RuntimeOptions, serialise
+    from ponyc_tpu.models import ring
+
+    tmp = tempfile.mkdtemp(prefix="pony_ckpt_bench_")
+    hops = int(getattr(args, "checkpoint_hops", 20_000))
+    base = dict(mailbox_cap=8, batch=1, max_sends=1, msg_words=1,
+                spill_cap=64, inject_slots=8, delivery=delivery,
+                pallas_fused=fused)
+    try:
+        # (a) baseline: checkpointing off
+        rt, ids = ring.build(128, RuntimeOptions(**base))
+        rt.send(int(ids[0]), ring.RingNode.token, hops)
+        t0 = time.perf_counter()
+        rt.run()
+        off_s = time.perf_counter() - t0
+        windows_off = max(1, rt._rl_windows)
+        want = np.asarray(rt.cohort_state(ring.RingNode)["passes"])
+        rt.stop()
+
+        # (b) the same run with the cadence checkpointer armed
+        prefix = tmp + "/ring"
+        rt2, ids2 = ring.build(128, RuntimeOptions(
+            **base, checkpoint_every_s=0.02, checkpoint_path=prefix,
+            checkpoint_keep=3))
+        rt2.send(int(ids2[0]), ring.RingNode.token, hops)
+        t0 = time.perf_counter()
+        rt2.run()
+        on_s = time.perf_counter() - t0
+        windows_on = max(1, rt2._rl_windows)
+        stats = rt2.checkpoint_stats()
+        equal_ok = bool((np.asarray(
+            rt2.cohort_state(ring.RingNode)["passes"]) == want).all())
+        rt2.stop()                      # final fast-start checkpoint
+
+        # (c) restore-fast-start: soaked world into a fresh runtime
+        newest = serialise.newest_intact(prefix)
+        ring_files = serialise.list_checkpoints(prefix)
+        intact_ok = True
+        for _seq, f in ring_files:
+            try:
+                serialise.verify_snapshot(f)
+            except Exception:            # noqa: BLE001
+                intact_ok = False
+        rt3, _ = ring.build(128, RuntimeOptions(**base))
+        t0 = time.perf_counter()
+        serialise.restore(rt3, newest)
+        restore_s = time.perf_counter() - t0
+        restore_equal_ok = bool((np.asarray(
+            rt3.cohort_state(ring.RingNode)["passes"]) == want).all())
+
+        n_ckpt = max(1, stats["checkpoints"])
+        return {
+            "hops": hops,
+            "checkpoints": stats["checkpoints"],
+            "ring_files": len(ring_files),
+            "ring_intact_ok": intact_ok,
+            "run_off_s": round(off_s, 4),
+            "run_on_s": round(on_s, 4),
+            # per-window tax of the armed checkpointer (wall-clock delta
+            # over the baseline; noisy at smoke scale — the capture/
+            # write costs below are the per-event truth)
+            "ckpt_cost_us_per_window": round(
+                max(0.0, on_s - off_s) / windows_on * 1e6, 1),
+            "windows": windows_on,
+            "windows_off": windows_off,
+            "capture_ms_mean": round(
+                stats["capture_ms_total"] / n_ckpt, 3),
+            "write_ms_mean": round(stats["write_ms_total"]
+                                   / max(1, stats["written"]), 3),
+            "write_failures": stats["failures"],
+            "bytes_last": stats["bytes_last"],
+            "restore_fast_start_s": round(restore_s, 4),
+            "equal_ok": bool(equal_ok and restore_equal_ok),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_latency(args, delivery="plan", fused=False):
     """p50 behaviour-dispatch latency: single token on a 1024-actor ring,
     one hop per tick. The headline number is the DEVICE-RESIDENT per-hop
@@ -558,6 +654,14 @@ def main():
                     "/metrics+/healthz over HTTP during run(), and "
                     "embed a `metrics` block asserting the final "
                     "counters equal Runtime.profile() (PROFILE.md §11)")
+    ap.add_argument("--checkpoint-smoke", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_CHECKPOINT_SMOKE", "0") == "1",
+                    help="durable-worlds smoke: a cadence-checkpointed "
+                    "run vs the same run with checkpointing off "
+                    "(ckpt_cost_us_per_window), per-checkpoint capture/"
+                    "write costs, and restore-fast-start time — "
+                    "embedded as a `checkpoint` block (PROFILE.md §12)")
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
@@ -658,6 +762,15 @@ def main():
                 args, delivery=ub["delivery"], fused=ub["pallas_fused"])
         except Exception as e:                   # noqa: BLE001
             metrics_block = {"error": str(e)}
+    # Durable-worlds smoke (--checkpoint-smoke): checkpoint cost per
+    # window + restore-fast-start time (PROFILE.md §12).
+    checkpoint_block = None
+    if args.checkpoint_smoke:
+        try:
+            checkpoint_block = bench_checkpoint_smoke(
+                args, delivery=ub["delivery"], fused=ub["pallas_fused"])
+        except Exception as e:                   # noqa: BLE001
+            checkpoint_block = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -704,6 +817,8 @@ def main():
         result["tracing"] = tracing_block
     if metrics_block is not None:
         result["metrics"] = metrics_block
+    if checkpoint_block is not None:
+        result["checkpoint"] = checkpoint_block
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error
         result["detail"]["tpu_env"] = tpu_env_details()

@@ -57,6 +57,28 @@ Commands:
                               one-line verdict + diagnosis. Exit:
                               0 ok/snapshot, 1 stalled/crashed/
                               degraded, 2 usage or unreadable.
+  supervise [--retries N]     run a workload script under restart-from-
+            [--backoff S]     checkpoint supervision (supervise.py): on
+            --prefix P        any nonzero/killed exit the child is
+            <script.py> [...]  restarted with PONY_TPU_RESTORE pointing
+                              at the newest intact ring checkpoint
+                              under --prefix (falling back past corrupt
+                              ones), with exponential backoff and the
+                              deterministic-poison refusal. The script
+                              opts in via supervise.maybe_restore(rt).
+                              Exit: the workload's final code (0 on
+                              recovery), 3 on poison, 2 usage.
+  snapshot <file|prefix>      inspect a world snapshot / checkpoint
+           [--json]           ring: header summary (format, program
+                              fingerprint, geometry, counters, age) +
+                              checksum verdict. Exit: 0 intact,
+                              1 corrupt/unreadable, 2 usage.
+  restore <file|prefix>       deep-verify restorability (every array
+                              checksummed, format gate) and print the
+                              verdict; a prefix resolves to the newest
+                              intact ring snapshot. In-program restore
+                              is serialise.restore(rt, path). Exit
+                              codes as for snapshot.
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -66,10 +88,12 @@ reference stripping --pony* before the app sees argv (start.c:185-261):
 
 from __future__ import annotations
 
+import json
 import os
 import runpy
 import subprocess
 import sys
+import time
 
 
 def _usage(code: int = 2) -> int:
@@ -503,6 +527,170 @@ def cmd_doctor(argv) -> int:
     return 0 if status == "ok" else 1
 
 
+def _resolve_snapshot_target(target: str):
+    """A snapshot CLI target is a file OR a checkpoint-ring prefix;
+    returns (path, err). Prefixes resolve to the newest intact ring
+    file (falling back past corrupt ones, like the supervisor)."""
+    from . import serialise
+    if os.path.exists(target):
+        return target, None
+    ring = serialise.list_checkpoints(target)
+    if not ring:
+        return None, (f"no such snapshot file and no checkpoint ring "
+                      f"under prefix {target!r}")
+    path = serialise.newest_intact(target)
+    if path is None:
+        return None, (f"all {len(ring)} ring snapshot(s) under "
+                      f"{target!r} are corrupt")
+    return path, None
+
+
+def cmd_snapshot(argv) -> int:
+    """Inspect a world snapshot (serialise.py): header summary +
+    checksum verdict. Exit 0 intact, 1 corrupt/unreadable, 2 usage."""
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1:
+        print("ponyc_tpu snapshot: need exactly one <file|ring-prefix>",
+              file=sys.stderr)
+        return 2
+    from . import serialise
+    path, err = _resolve_snapshot_target(argv[0])
+    if err:
+        print(f"ponyc_tpu snapshot: {err}", file=sys.stderr)
+        return 1 if "corrupt" in err else 2
+    try:
+        header = serialise.verify_snapshot(path)
+    except (serialise.SnapshotCorruptError,
+            serialise.SnapshotFormatError, OSError) as e:
+        print(f"ponyc_tpu snapshot: CORRUPT — {e}", file=sys.stderr)
+        return 1
+    geo = header.get("geometry", {})
+    info = {
+        "path": path,
+        "format": header.get("format"),
+        "intact": True,
+        "fingerprint": header.get("fingerprint"),
+        "age_s": (round(time.time() - header["time"], 1)
+                  if header.get("time") else None),
+        "steps_run": header.get("steps_run"),
+        "actors_total": geo.get("total"),
+        "shards": geo.get("shards"),
+        "mailbox_cap": geo.get("mailbox_cap"),
+        "cohorts": {c["name"]: c["capacity"]
+                    for c in geo.get("cohorts", [])},
+        "totals": header.get("totals", {}),
+    }
+    if as_json:
+        print(json.dumps(info))
+    else:
+        print(f"{path}: INTACT (format v{info['format']}, "
+              f"fingerprint {info['fingerprint']})")
+        print(f"  steps_run={info['steps_run']} "
+              f"actors={info['actors_total']} shards={info['shards']} "
+              f"mailbox_cap={info['mailbox_cap']}"
+              + (f" age={info['age_s']}s"
+                 if info["age_s"] is not None else ""))
+        if info["cohorts"]:
+            print("  cohorts: " + ", ".join(
+                f"{n}[{c}]" for n, c in info["cohorts"].items()))
+    return 0
+
+
+def cmd_restore(argv) -> int:
+    """Deep restorability check: full verification of every array plus
+    the format gate — what serialise.restore() would accept. Exit 0
+    restorable, 1 corrupt/unreadable, 2 usage."""
+    if len(argv) != 1:
+        print("ponyc_tpu restore: need exactly one <file|ring-prefix> "
+              "(in-program restore is serialise.restore(rt, path))",
+              file=sys.stderr)
+        return 2
+    from . import serialise
+    path, err = _resolve_snapshot_target(argv[0])
+    if err:
+        print(f"ponyc_tpu restore: {err}", file=sys.stderr)
+        return 1 if "corrupt" in err else 2
+    try:
+        header = serialise.verify_snapshot(path)
+    except (serialise.SnapshotCorruptError,
+            serialise.SnapshotFormatError, OSError) as e:
+        print(f"ponyc_tpu restore: NOT RESTORABLE — {e}",
+              file=sys.stderr)
+        return 1
+    geo = header.get("geometry", {})
+    print(f"{path}: RESTORABLE (format v{header.get('format')}, "
+          f"{geo.get('total', '?')} actor rows, "
+          f"step {header.get('steps_run', '?')}; restore with "
+          "serialise.restore(rt, path) — geometry may differ since v3)")
+    return 0
+
+
+def cmd_supervise(argv) -> int:
+    """Run a workload script under restart-from-checkpoint supervision
+    (supervise.Supervisor subprocess mode)."""
+    retries, backoff, prefix = 5, 0.25, None
+    rest: list = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if rest:                      # after the script: its own argv
+            rest.append(a)
+            i += 1
+            continue
+        if a in ("--retries", "--backoff", "--prefix"):
+            if i + 1 >= len(argv):
+                print(f"ponyc_tpu supervise: {a} needs a value",
+                      file=sys.stderr)
+                return 2
+            try:
+                if a == "--retries":
+                    retries = int(argv[i + 1])
+                elif a == "--backoff":
+                    backoff = float(argv[i + 1])
+                else:
+                    prefix = argv[i + 1]
+            except ValueError:
+                print(f"ponyc_tpu supervise: bad value for {a}: "
+                      f"{argv[i + 1]!r}", file=sys.stderr)
+                return 2
+            i += 2
+            continue
+        rest.append(a)
+        i += 1
+    if not rest or prefix is None:
+        print("ponyc_tpu supervise: need --prefix <checkpoint-prefix> "
+              "and a <script.py> (the script should set "
+              "RuntimeOptions(checkpoint_every_s=..., checkpoint_path="
+              "<prefix>) and call supervise.maybe_restore(rt))",
+              file=sys.stderr)
+        return 2
+    script = rest[0]
+    if not os.path.exists(script):
+        print(f"ponyc_tpu supervise: no such script: {script}",
+              file=sys.stderr)
+        return 2
+    from .supervise import PoisonError, Supervisor
+    # The child must find THIS ponyc_tpu whatever directory its script
+    # lives in: append our package root to PYTHONPATH (append, not
+    # replace — the TPU env's sitecustomize path must stay first).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    os.environ["PYTHONPATH"] = (existing + os.pathsep + pkg_root
+                                if existing else pkg_root)
+    sup = Supervisor(argv=[sys.executable, script] + rest[1:],
+                     prefix=prefix, retries=retries, backoff_s=backoff)
+    try:
+        code = sup.run()
+    except PoisonError as e:
+        print(f"ponyc_tpu supervise: POISON — {e}", file=sys.stderr)
+        return 3
+    if sup.restarts:
+        print(f"ponyc_tpu supervise: recovered after {sup.restarts} "
+              f"restart(s); final exit {code}", file=sys.stderr)
+    return code
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -519,7 +707,8 @@ def cmd_version(_argv) -> int:
 COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
             "doc": cmd_doc, "verify": cmd_verify, "lint": cmd_lint,
             "trace": cmd_trace, "top": cmd_top, "doctor": cmd_doctor,
-            "version": cmd_version}
+            "supervise": cmd_supervise, "snapshot": cmd_snapshot,
+            "restore": cmd_restore, "version": cmd_version}
 
 
 def main(argv=None) -> int:

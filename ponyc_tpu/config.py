@@ -225,6 +225,27 @@ class RuntimeOptions:
     #   run loop last pushed at a window boundary (the same
     #   non-blocking posture as the analysis writer)
 
+    # --- durable worlds (serialise.py Checkpointer + supervise.py;
+    # ≙ nothing in the reference — Pony has no built-in checkpoint/
+    # restore (SURVEY.md §5); the TPU runtime's single-pytree world
+    # makes one cheap. All three knobs are HOST-side: the traced step
+    # never sees them, so the step jaxpr is bit-identical with
+    # checkpointing on or off (tests/test_durability.py asserts). ---
+    checkpoint_every_s: Optional[float] = None  # periodic crash-safe
+    #   checkpoint cadence in seconds (None = off): the run loop
+    #   snapshots the whole world at the next quiescent window boundary
+    #   once this much time has passed — capture (device→host copy,
+    #   started async) runs on the run-loop thread; compression,
+    #   checksumming and the fsync+atomic-rename write ride a
+    #   background writer thread behind the next in-flight window
+    #   (Runtime.checkpoint_stats() records both costs, PROFILE.md §12)
+    checkpoint_path: str = ""      # checkpoint ring file PREFIX; files
+    #   land as <prefix>-<seq>.ckpt with the newest `checkpoint_keep`
+    #   retained. "" = derive <analysis_path>.ckpt
+    checkpoint_keep: int = 3       # how many ring snapshots to retain
+    #   (the supervisor falls back past corrupt ones, so > 1 is the
+    #   crash-safety margin; old files beyond K are deleted)
+
     # --- autotuning / caches (tuning.py; ≙ nothing in the reference —
     # its dispatch is one fixed O(1) switch, genfun.c; ours has
     # formulation choices whose winner is hardware- and shape-dependent,
@@ -313,6 +334,12 @@ class RuntimeOptions:
             raise ValueError(
                 "metrics_port must be in [0, 65535] (0 = ephemeral, "
                 "None = off)")
+        if self.checkpoint_every_s is not None \
+                and not self.checkpoint_every_s > 0:
+            raise ValueError(
+                "checkpoint_every_s must be > 0 seconds (None = off)")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
         if self.blob_slots < 0 or self.blob_words < 0:
             raise ValueError("blob_slots/blob_words must be >= 0")
         if (self.blob_slots > 0) != (self.blob_words > 0):

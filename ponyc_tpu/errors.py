@@ -58,6 +58,15 @@ ERROR_CODES = {
     "CapabilityError": 5,        # hostmem.py — capability discipline
     "VerifyError": 6,            # verify.py — behaviour budget violation
     "PonyStallError": 7,         # this file — watchdog-declared stall
+    "SnapshotCorruptError": 8,   # serialise.py — checkpoint failed its
+    #   checksum/structure verification (truncated/bit-flipped file)
+    "SnapshotFormatError": 9,    # serialise.py — snapshot written by an
+    #   unknown FUTURE format version (loud, never a silent drop)
+    "SnapshotGeometryError": 10,  # serialise.py — a geometry-changing
+    #   restore found occupancy that does not fit the new layout
+    "PoisonError": 11,           # supervise.py — deterministic poison:
+    #   the same coded error at the same world position twice; the
+    #   supervisor refuses to restart-loop on it
 }
 
 

@@ -181,6 +181,12 @@ class FlightRecorder:
             "errors": [{"class": cls, "code": int(code), "count": int(n)}
                        for (cls, code), n in sorted(
                            getattr(rt, "_error_counts", {}).items())],
+            # Durable-worlds evidence (ISSUE 8): where the newest
+            # restorable checkpoint lives — the first thing an operator
+            # (or the supervisor) needs from a crash dump.
+            "checkpoint": (rt._ckpt.info()
+                           if getattr(rt, "_ckpt", None) is not None
+                           else None),
             "controller": (None if ctrl is None else {
                 **ctrl.snapshot(),
                 "recent": ctrl.recent_decisions()}),
@@ -371,6 +377,14 @@ def render_postmortem(pm: Dict[str, Any]) -> str:
     for e in errs:
         lines.append(f"error: {e['class']} (code {e['code']}) "
                      f"x{e['count']}")
+    ck = pm.get("checkpoint")
+    if ck and ck.get("path"):
+        lines.append(
+            f"restorable from: {ck['path']} (age {ck.get('age_s', '?')}s,"
+            f" seq {ck.get('seq', '?')}, checksum "
+            f"{'ok' if ck.get('verified') else 'unverified'})")
+    elif ck is not None:
+        lines.append("restorable from: (no checkpoint written yet)")
     ctrl = pm.get("controller")
     if ctrl:
         lines.append(f"controller: window={ctrl.get('window')} "
@@ -446,4 +460,9 @@ def diagnose_postmortem(pm: Dict[str, Any]) -> Tuple[str, str]:
             and "STALLED" in line:
         line += (f"; {last['occ_sum']} message(s) still queued "
                  f"(deepest {last['occ_max']})")
+    ck = pm.get("checkpoint")
+    if ck and ck.get("path") and line.startswith(("STALLED", "CRASHED")):
+        # The doctor's recovery pointer: what the supervisor would
+        # restore from (`python -m ponyc_tpu supervise`, supervise.py).
+        line += f" — restorable from {ck['path']}"
     return line, render_postmortem(pm)

@@ -131,6 +131,8 @@ def health(rt) -> Dict[str, Any]:
             status = "degraded"
             reason = "telemetry ring drops: " + ", ".join(
                 f"{k}={v}" for k, v in drops.items() if int(v))
+    ck = getattr(rt, "_ckpt", None)
+    ck_info = ck.info() if ck is not None else None
     return {
         "status": status,
         "reason": reason,
@@ -139,6 +141,12 @@ def health(rt) -> Dict[str, Any]:
         "steps": int(getattr(rt, "steps_run", 0)),
         "snapshot_age_s": (round(time.time() - snap["time"], 3)
                            if snap.get("time") else None),
+        # Durable worlds (ISSUE 8): how stale a crash-restore would be.
+        # None = checkpointing off; alert on staleness > 2-3 cadences.
+        "last_checkpoint_age_s": (ck_info.get("age_s")
+                                  if ck_info is not None else None),
+        "last_checkpoint_path": (ck_info.get("path")
+                                 if ck_info is not None else None),
         "watchdog": wd.snapshot() if wd is not None else None,
     }
 

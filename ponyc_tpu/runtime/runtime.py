@@ -257,6 +257,12 @@ class Runtime:
         self._watchdog = None         # flight.Watchdog when watchdog_s
         self._metrics = None          # metrics.MetricsServer when
         #   metrics_port is not None
+        self._ckpt = None             # serialise.Checkpointer when
+        #   checkpoint_every_s is set (durable worlds, PROFILE.md §12)
+        self._last_run_crashed = False  # run() exited exceptionally:
+        #   stop() must NOT overwrite the ring's newest snapshot with
+        #   the post-crash world (the supervisor restores the last
+        #   intact PRE-crash checkpoint)
         self._wd_epoch = 0            # phase-stamp progress counter
         self._wd_stamp = ("idle", 0, time.monotonic())  # (phase,
         #   epoch, t): one tuple assignment per transition — the cheap
@@ -320,6 +326,9 @@ class Runtime:
             self._metrics = _metrics.MetricsServer(
                 self, self.opts.metrics_port)
             self._metrics.update_now(self)
+        if self.opts.checkpoint_every_s is not None:
+            from .. import serialise as _serialise
+            self._ckpt = _serialise.Checkpointer(self)
         self._stamp("idle")
         return self
 
@@ -1435,6 +1444,7 @@ class Runtime:
         a = None          # newest RETIRED aux; None forces a first window
         win = None        # the one in-flight (unretired) window
         self._last_retire_t = None
+        self._last_run_crashed = False
         # SIGQUIT = dump the flight recorder and keep running (the
         # operator's "what is it doing RIGHT NOW" key, ^\ on a tty;
         # SIGTERM/SIGUSR1 stay the analysis dump's, PROFILE.md §8).
@@ -1488,7 +1498,15 @@ class Runtime:
                     # in-flight aux, so it self-cancels if that window
                     # ends needing host attention or quiet.
                     spec = None
-                    if pipelining and a is not None and self._clean_busy(a):
+                    # A due checkpoint suppresses the next speculation:
+                    # the following boundary then has no in-flight
+                    # window, which is exactly the quiescent-consistent
+                    # point the snapshot needs (delay bounded by ONE
+                    # window).
+                    ckpt_due = (self._ckpt is not None
+                                and self._ckpt.due())
+                    if pipelining and not ckpt_due \
+                            and a is not None and self._clean_busy(a):
                         budget = ctrl.window
                         if max_steps is not None:
                             budget = min(budget,
@@ -1543,6 +1561,22 @@ class Runtime:
                                  >= self.opts.cd_interval))):
                     self._last_gc_step = eff_step
                     self.gc()
+                # Periodic crash-safe checkpoint (PROFILE.md §12): the
+                # world is quiescent-consistent here whenever no window
+                # is in flight (retired state + host queues = exactly
+                # what serialise captures); the device→host copy runs
+                # now, the file write rides the background writer
+                # behind the next window. Never lets a checkpointing
+                # failure take down the run it exists to protect.
+                if self._ckpt is not None and win is None:
+                    try:
+                        self._ckpt.tick(self, in_flight=False)
+                    except Exception as e:          # noqa: BLE001
+                        self.totals["checkpoint_errors"] += 1
+                        if self._flight is not None:
+                            self._flight.event(
+                                "checkpoint_failed",
+                                error=f"{type(e).__name__}: {e}")
                 if self._exit_requested:
                     self._exit_requested = False    # consume the request
                     break
@@ -1670,6 +1704,8 @@ class Runtime:
             # dumps the black box. Stall trips already dumped (the
             # watchdog thread wrote it before interrupting us).
             exc = _sys.exc_info()[1]
+            self._last_run_crashed = (exc is not None
+                                      and not isinstance(exc, SystemExit))
             if (exc is not None and self._flight is not None
                     and not isinstance(exc, (SystemExit,
                                              PonyStallError))):
@@ -1709,6 +1745,28 @@ class Runtime:
                            if self._controller is not None else None),
         }
 
+    def checkpoint(self, path: Optional[str] = None) -> Optional[str]:
+        """Write one on-demand snapshot: to `path` (synchronous,
+        serialise.save) or into the periodic ring (async write;
+        requires checkpoint_every_s — returns the queued file's path).
+        Call between runs/steps only, like serialise.save."""
+        from .. import serialise as _serialise
+        if path is not None:
+            _serialise.save(self, path)
+            return path
+        if self._ckpt is None:
+            raise RuntimeError(
+                "no checkpoint ring configured: pass path=, or set "
+                "RuntimeOptions.checkpoint_every_s/checkpoint_path")
+        seq = self._ckpt.checkpoint(self, force=True)
+        return _serialise.checkpoint_file(self._ckpt.prefix, seq)
+
+    def checkpoint_stats(self) -> Optional[Dict[str, Any]]:
+        """Checkpointer telemetry (PROFILE.md §12): capture/write costs
+        and the newest restorable snapshot; None when checkpointing is
+        off."""
+        return self._ckpt.stats() if self._ckpt is not None else None
+
     def request_exit(self, code: int = 0) -> None:
         """Ask the run loop to stop at the next host boundary (≙
         pony_exitcode + the quiescent stop, start.c:345 — but callable
@@ -1737,6 +1795,21 @@ class Runtime:
             self._bridge_pollers = [p for p in self._bridge_pollers
                                     if p is not b]
         wd = self._watchdog
+        stalled_wd = wd is not None and wd.tripped is not None
+        if self._ckpt is not None:
+            if not stalled_wd and not self._last_run_crashed:
+                # Final checkpoint on clean teardown — the fast-start
+                # restore source. Skipped after a stall (capture would
+                # hang on the wedged device) and after ANY crashed
+                # run: the ring's newest snapshot must stay the last
+                # intact PRE-crash world, or the supervisor would
+                # restore straight back into the failure.
+                try:
+                    self._ckpt.checkpoint(self, force=True)
+                except Exception:                  # noqa: BLE001
+                    self.totals["checkpoint_errors"] += 1
+            self._ckpt.close()
+            self._ckpt = None
         if wd is not None:
             wd.close()
             self._watchdog = None

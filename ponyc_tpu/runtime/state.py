@@ -402,6 +402,41 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     )
 
 
+def geometry_descriptor(program: Program, opts: RuntimeOptions):
+    """The layout facts a snapshot must carry so a restore can re-lay-out
+    the SoA arrays into a DIFFERENT geometry (serialise.py): everything
+    that sizes an array without changing program STRUCTURE. Cohorts are
+    in declaration order (behaviour gids depend on it — covered by the
+    structural fingerprint); slots are the geometry-independent actor
+    identity (slot s of cohort C is the same actor whatever the shard
+    count or capacity)."""
+    assert program.frozen
+    return {
+        "shards": program.shards,
+        "n_local": program.n_local,
+        "total": program.total,
+        "mailbox_cap": opts.mailbox_cap,
+        "msg_words": opts.msg_words,
+        "trace_lanes": opts.trace_lanes,
+        "spill_cap": opts.spill_cap,
+        "mute_slots": opts.mute_slots,
+        "blob_slots": opts.blob_slots,
+        "blob_words": opts.blob_words,
+        "analysis": opts.analysis,
+        "trace_slots": opts.trace_slots if opts.tracing else 0,
+        "analysis_events": (opts.analysis_events
+                            if opts.analysis >= 3 else 0),
+        "cohorts": [{
+            "name": c.atype.__name__,
+            "capacity": c.capacity,
+            "local_capacity": c.local_capacity,
+            "local_start": c.local_start,
+            "host": bool(c.host),
+            "msg_words": c.msg_words,
+        } for c in program.cohorts],
+    }
+
+
 def state_partition_specs(program: Program, opts: RuntimeOptions):
     """PartitionSpec pytree matching RtState: every array shards its
     LAST axis over the 'actors' mesh axis (the lane/actor dimension —

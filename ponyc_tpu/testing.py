@@ -214,3 +214,173 @@ def run_tests(*tests: UnitTest, **kw) -> bool:
     for t in tests:
         r.add(t)
     return r.run()
+
+
+# ---------------------------------------------------------------------------
+# Chaos / fault-injection harness (ISSUE 8; ≙ nothing in the reference —
+# its test suite has no fault injector, SURVEY.md §4). Small, explicit
+# hooks the durability acceptance tests use to prove kill → restart →
+# restore → identical outcomes end-to-end: wedge a behaviour (the
+# watchdog's code-7 path), raise a coded fatal at a chosen host
+# boundary, corrupt/truncate a snapshot file, and SIGKILL the process —
+# including deterministically MID-FLUSH inside a checkpoint write (the
+# serialise.py chaos point). Hooks are one-shot by default so a
+# supervised restart runs clean; subprocess tests arm them through the
+# PONY_TPU_CHAOS env var ("<point>[@<nth>]", comma-separated).
+
+class ChaosHooks:
+    """Process-global registry of armed fault points. `fire(point)` is
+    called from instrumented runtime sites and is a no-op unless that
+    point was armed; an armed point triggers on its Nth firing and then
+    disarms (one-shot), so recovery paths run unfaulted."""
+
+    KILL = "kill"          # SIGKILL self — the mid-flush crash
+    _ACTIONS = (KILL,)
+
+    def __init__(self):
+        self._armed = {}           # point -> {"after": n, "seen": k,
+        #                             "action": callable|KILL}
+        self._env_loaded = False
+
+    def arm(self, point: str, action="kill", after: int = 1) -> None:
+        if after < 1:
+            raise ValueError("after must be >= 1 (fires on the Nth hit)")
+        if isinstance(action, str) and action not in self._ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}")
+        self._armed[point] = {"after": int(after), "seen": 0,
+                              "action": action}
+
+    def disarm(self, point: str) -> None:
+        self._armed.pop(point, None)
+
+    def reset(self) -> None:
+        self._armed.clear()
+        self._env_loaded = True    # a reset also cancels env arming
+
+    def _load_env(self) -> None:
+        # "snapshot-mid-flush@2,other-point" — subprocess arming channel
+        # (a supervised child cannot be reached through Python calls).
+        self._env_loaded = True
+        import os
+        spec = os.environ.get("PONY_TPU_CHAOS", "")
+        for part in (p.strip() for p in spec.split(",") if p.strip()):
+            point, _, nth = part.partition("@")
+            self.arm(point, after=int(nth) if nth else 1)
+
+    def fire(self, point: str) -> None:
+        if not self._env_loaded:
+            self._load_env()
+        hook = self._armed.get(point)
+        if hook is None:
+            return
+        hook["seen"] += 1
+        if hook["seen"] < hook["after"]:
+            return
+        del self._armed[point]
+        if hook["action"] == self.KILL:
+            import os
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        else:
+            hook["action"]()
+
+
+chaos = ChaosHooks()
+
+
+def wedge_behaviour(bdef, at_dispatch: int = 1, sleep_s: float = 600.0):
+    """Wedge a HOST behaviour: its `at_dispatch`-th call sleeps
+    `sleep_s` (the stall watchdog's code-7 evidence), then the original
+    body is restored — one-shot, so a supervised restart completes.
+    Returns an undo callable."""
+    import time as _time
+    orig = bdef.fn
+    state = {"n": 0}
+
+    def wedged(ctx, st, *args):
+        state["n"] += 1
+        if state["n"] == at_dispatch:
+            bdef.fn = orig             # disarm BEFORE sleeping: the
+            _time.sleep(sleep_s)       # interrupted retry runs clean
+        return orig(ctx, st, *args)
+
+    bdef.fn = wedged
+
+    def undo():
+        bdef.fn = orig
+    return undo
+
+
+class FatalAtBoundary:
+    """Bridge poller raising a coded PonyError at its Nth host boundary
+    — a deterministic coded fatal mid-run (one-shot unless
+    `every=True`, the poison-rule fixture)."""
+
+    def __init__(self, boundary: int = 2, code: int = 99,
+                 every: bool = False):
+        self.boundary = int(boundary)
+        self.code = int(code)
+        self.every = every
+        self.polls = 0
+        self.fired = 0
+
+    def poll(self, rt) -> None:
+        from .errors import PonyError
+        self.polls += 1
+        if self.polls == self.boundary or (self.every
+                                           and self.polls >= self.boundary):
+            self.fired += 1
+            raise PonyError(self.code,
+                            f"chaos: injected fatal at boundary "
+                            f"{self.polls}")
+
+
+def fatal_at_boundary(rt, boundary: int = 2, code: int = 99,
+                      every: bool = False) -> "FatalAtBoundary":
+    hook = FatalAtBoundary(boundary, code, every)
+    rt.register_poller(hook)
+    return hook
+
+
+def corrupt_snapshot(path: str, mode: str = "truncate") -> None:
+    """Damage a snapshot file in a controlled way: "truncate" keeps the
+    first half (torn write), "bitflip" flips one byte INSIDE the
+    largest zip member's array payload (real bit rot — a flip in zip
+    bookkeeping slack would be benign) — restore() must answer with the
+    coded SnapshotCorruptError, never a raw numpy/zlib traceback."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if mode == "truncate":
+        data = data[:max(1, len(data) // 2)]
+    elif mode == "bitflip":
+        import io
+        import struct
+        import zipfile
+        with zipfile.ZipFile(io.BytesIO(bytes(data))) as zf:
+            zi = max(zf.infolist(), key=lambda i: i.compress_size)
+        # local header: sig4 ver2 flag2 method2 time2 date2 crc4
+        # csize4 usize4 fnlen2 extralen2, then filename+extra, then data
+        fnlen, extralen = struct.unpack_from(
+            "<HH", data, zi.header_offset + 26)
+        data_off = zi.header_offset + 30 + fnlen + extralen
+        data[data_off + zi.compress_size // 2] ^= 0x40
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+def sigkill_after(delay_s: float) -> threading.Thread:
+    """Arm a hard SIGKILL of THIS process after `delay_s` — the
+    unclean-death fixture (no atexit, no finally, exactly like the OOM
+    killer). Returns the (daemon) timer thread."""
+    import os
+    import signal
+
+    def _kill():
+        time.sleep(delay_s)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    t = threading.Thread(target=_kill, name="chaos-sigkill", daemon=True)
+    t.start()
+    return t

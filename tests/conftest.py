@@ -16,3 +16,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ponyc_tpu.platforms import force_cpu  # noqa: E402
 
 force_cpu(8)
+
+
+def pytest_configure(config):
+    # Tier-1 runs with `-m 'not slow'` (ROADMAP); register the marker
+    # so opting a heavyweight test out of the budget is warning-free.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run")

@@ -108,6 +108,22 @@ def test_bench_metrics_smoke_block(bench_mod):
     assert m["port"] == 0                # ephemeral requested
 
 
+def test_bench_checkpoint_smoke_block(bench_mod):
+    """The --checkpoint-smoke `checkpoint` block (durable worlds,
+    PROFILE.md §12): a cadence-checkpointed run keeps the unfaulted
+    outcome, the ring stays intact+bounded, and a restore-fast-start
+    reproduces the soaked world."""
+    c = bench_mod.bench_checkpoint_smoke(_args(checkpoint_hops=5000),
+                                         delivery="plan", fused=False)
+    assert c["equal_ok"], c
+    assert c["ring_intact_ok"], c
+    assert c["checkpoints"] >= 1
+    assert 1 <= c["ring_files"] <= 3
+    assert c["write_failures"] == 0
+    assert c["capture_ms_mean"] >= 0
+    assert c["restore_fast_start_s"] < 30
+
+
 def test_tpu_env_details_shape(bench_mod):
     """The tpu_init_error env snapshot: JSON-serialisable, secrets
     filtered, libtpu presence probed."""

@@ -503,7 +503,12 @@ def test_shipped_trees_lint_clean_pure_ast():
          # walk must stay clean as they grow
          os.path.join(ROOT, "ponyc_tpu", "tracing.py"),
          os.path.join(ROOT, "ponyc_tpu", "flight.py"),
-         os.path.join(ROOT, "ponyc_tpu", "metrics.py")])
+         os.path.join(ROOT, "ponyc_tpu", "metrics.py"),
+         # durability layer (ISSUE 8): snapshot/checkpoint machinery,
+         # the supervisor, and the chaos harness
+         os.path.join(ROOT, "ponyc_tpu", "serialise.py"),
+         os.path.join(ROOT, "ponyc_tpu", "supervise.py"),
+         os.path.join(ROOT, "ponyc_tpu", "testing.py")])
     dt = time.perf_counter() - t0
     assert findings == [], "\n".join(str(f) for f in findings)
     assert n_types >= 25 and n_beh >= 35

@@ -131,7 +131,10 @@ def test_error_code_table_is_stable():
     assert ERROR_CODES == {
         "PonyError": 1, "SpillOverflowError": 2,
         "SpawnCapacityError": 3, "BlobCapacityError": 4,
-        "CapabilityError": 5, "VerifyError": 6, "PonyStallError": 7}
+        "CapabilityError": 5, "VerifyError": 6, "PonyStallError": 7,
+        # Durable worlds (ISSUE 8) — codes are append-only.
+        "SnapshotCorruptError": 8, "SnapshotFormatError": 9,
+        "SnapshotGeometryError": 10, "PoisonError": 11}
 
 
 def test_error_classes_expose_codes():
