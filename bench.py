@@ -47,7 +47,7 @@ went, not just totals. The timed headline pass itself stays level 0.
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
                         [--delivery auto|plan|cosort] [--fused auto|on|off]
                         [--trace-smoke] [--metrics-smoke]
-                        [--checkpoint-smoke]
+                        [--checkpoint-smoke] [--serve-smoke]
 
 --trace-smoke adds a `tracing` block: one sampled causal-tracing pass
 (analysis=3, trace_sample=1, PROFILE.md §10) reassembled and checked
@@ -57,7 +57,11 @@ HTTP exporter (RuntimeOptions.metrics_port, PROFILE.md §11) whose
 final counters must equal Runtime.profile(). --checkpoint-smoke adds
 a `checkpoint` block: checkpoint cost per window, per-checkpoint
 capture/write costs and restore-fast-start time (durable worlds,
-PROFILE.md §12). Every run records
+PROFILE.md §12). --serve-smoke adds a `serving` block: the real socket
+front door (serve.py) driven by loadgen.py at ~2x measured capacity —
+p50/p99 end-to-end latency of admitted requests, shed rate at the
+edge, goodput, and the rings-never-sticky-fail check (PROFILE.md
+§13). Every run records
 `backend_init_s`, and a failed TPU init — including --platform tpu,
 which now probes in a subprocess instead of hanging in-process — emits
 an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`)
@@ -598,6 +602,80 @@ def bench_latency(args, delivery="plan", fused=False):
     }
 
 
+def bench_serve_smoke(args, delivery="plan", fused=False):
+    """Serving front door smoke (ISSUE 9; --serve-smoke): the standing
+    `serving` BENCH block. Phase 1 measures service capacity with a
+    gentle closed loop; phase 2 offers ~2x that in concurrent demand
+    (conns x depth far past the worker pool) for a fixed window and
+    records what the north-star claim needs a number for: p50/p99
+    end-to-end latency of ADMITTED requests, shed rate at the edge,
+    and goodput under overload — then drains gracefully and asserts
+    the mailbox rings never hit a sticky-fail state. Bounded world;
+    never sinks a headline run (main() guards with try/except)."""
+    import threading
+
+    from ponyc_tpu import loadgen, serve
+
+    workers = 16
+    opts = serve.default_options(workers, delivery=delivery,
+                                 pallas_fused=fused)
+    rt, server = serve.build(workers, opts)
+    port = server.listen("127.0.0.1", 0)
+    out = {}
+
+    def client():
+        try:
+            out["calib"] = loadgen.run_load(
+                "127.0.0.1", port, conns=2, depth=2, requests=30)
+            out["load"] = loadgen.run_load(
+                "127.0.0.1", port, conns=4, depth=4 * workers,
+                requests=1 << 30, duration_s=2.0,
+                busy_backoff_s=0.005)
+        finally:
+            server.begin_drain()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    code = rt.run()
+    t.join(timeout=60.0)
+    stats = server.stats()
+    sticky = {f"{cls}:{c}": int(n)
+              for (cls, c), n in rt._error_counts.items()
+              if cls in ("SpillOverflowError", "SpawnCapacityError",
+                         "BlobCapacityError")}
+    rt.stop()
+    calib = out.get("calib") or {}
+    load = out.get("load") or {}
+    capacity = max(1.0, calib.get("goodput_rps", 0.0))
+    return {
+        "workers": workers,
+        "capacity_rps_est": round(capacity, 1),
+        "offered_rps": load.get("offered_rps", 0.0),
+        "overload_x": round(load.get("offered_rps", 0.0) / capacity, 2),
+        "sent": load.get("sent", 0),
+        "ok": load.get("ok", 0),
+        "busy": load.get("busy", 0),
+        "unanswered": load.get("unanswered", 0),
+        "bad_value": load.get("bad_value", 0),
+        "p50_us": load.get("p50_us", 0),
+        "p99_us": load.get("p99_us", 0),
+        "goodput_rps": load.get("goodput_rps", 0.0),
+        "shed_rate": load.get("shed_rate", 0.0),
+        "shed_by_reason": stats["shed"],
+        "admission": stats["admission"],
+        "batches": stats["batches"],
+        "submitted": stats["submitted"],
+        "rings_sticky_fail": sticky,          # must stay empty: the
+        #   edge shed BEFORE the device could wedge
+        "rings_ok": bool(not sticky and code == 0),
+        "drained_ok": bool(stats["drained"] and code == 0),
+        "shed_ok": bool(load.get("busy", 0) > 0),
+        "replies_accounted": bool(
+            load.get("unanswered", 0) == 0 and calib.get(
+                "unanswered", 0) == 0),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--actors", type=int,
@@ -662,6 +740,15 @@ def main():
                     "(ckpt_cost_us_per_window), per-checkpoint capture/"
                     "write costs, and restore-fast-start time — "
                     "embedded as a `checkpoint` block (PROFILE.md §12)")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_SERVE_SMOKE", "0") == "1",
+                    help="serving front door smoke (ISSUE 9): drive "
+                    "the real socket ingress tier (serve.py) with "
+                    "loadgen at ~2x measured capacity and embed a "
+                    "`serving` block — p50/p99 end-to-end latency of "
+                    "admitted requests, shed rate, goodput, and the "
+                    "rings-never-sticky-fail check")
     args = ap.parse_args()
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
@@ -771,6 +858,16 @@ def main():
                 args, delivery=ub["delivery"], fused=ub["pallas_fused"])
         except Exception as e:                   # noqa: BLE001
             checkpoint_block = {"error": str(e)}
+    # Serving front door smoke (--serve-smoke): the standing 2x-
+    # overload record of ISSUE 9 — p50/p99 of admitted requests, shed
+    # rate, goodput, rings-never-sticky-fail.
+    serving_block = None
+    if args.serve_smoke:
+        try:
+            serving_block = bench_serve_smoke(
+                args, delivery=ub["delivery"], fused=ub["pallas_fused"])
+        except Exception as e:                   # noqa: BLE001
+            serving_block = {"error": str(e)}
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -819,6 +916,8 @@ def main():
         result["metrics"] = metrics_block
     if checkpoint_block is not None:
         result["checkpoint"] = checkpoint_block
+    if serving_block is not None:
+        result["serving"] = serving_block
     if tpu_error is not None:
         result["detail"]["tpu_init_error"] = tpu_error
         result["detail"]["tpu_env"] = tpu_env_details()

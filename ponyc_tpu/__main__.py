@@ -79,6 +79,17 @@ Commands:
                               intact ring snapshot. In-program restore
                               is serialise.restore(rt, path). Exit
                               codes as for snapshot.
+  serve [--host H] [--port P] run the serving front door (serve.py):
+        [--workers N]         batched TCP(/TLS) ingress over the
+        [--tls-cert C]        default ServeWorker compute service,
+        [--tls-key K]         telemetry-driven admission control,
+        [--pending-limit B]   graceful SIGTERM drain. Length-prefixed
+        [--drain-grace S]     i32-word frames (README "Serving
+                              traffic"); --pony* runtime flags
+                              accepted. Pair with the load generator:
+                              python -m ponyc_tpu.loadgen HOST PORT.
+                              Exit: 0 drained, the error code on a
+                              coded failure (supervise restarts it).
   version                     print version + backend info.
 
 Runtime flags accepted anywhere in `run` argv, exactly like the
@@ -691,6 +702,14 @@ def cmd_supervise(argv) -> int:
     return code
 
 
+def cmd_serve(argv) -> int:
+    """Run the serving front door (serve.py: batched socket ingress,
+    admission control, graceful drain) over the default compute
+    service."""
+    from .serve import main as serve_main
+    return serve_main(argv)
+
+
 def cmd_version(_argv) -> int:
     from . import __version__
     print(f"ponyc_tpu {__version__}")
@@ -708,7 +727,8 @@ COMMANDS = {"run": cmd_run, "bench": cmd_bench, "test": cmd_test,
             "doc": cmd_doc, "verify": cmd_verify, "lint": cmd_lint,
             "trace": cmd_trace, "top": cmd_top, "doctor": cmd_doctor,
             "supervise": cmd_supervise, "snapshot": cmd_snapshot,
-            "restore": cmd_restore, "version": cmd_version}
+            "restore": cmd_restore, "serve": cmd_serve,
+            "version": cmd_version}
 
 
 def main(argv=None) -> int:

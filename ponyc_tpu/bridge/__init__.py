@@ -141,7 +141,24 @@ class Bridge:
         for ev in self.loop.drain():
             cb = self._cbs.get(ev.sub_id)
             if cb is not None:
-                cb(ev)
+                # A raising fd/timer callback must not kill the run
+                # loop's host-work phase (≙ the reference's ASIO thread
+                # surviving a notify that traps): count it per
+                # (class, code), leave flight-recorder evidence, and
+                # keep draining — the subscription stays live, exactly
+                # like a host behaviour's PonyError residue.
+                try:
+                    cb(ev)
+                except Exception as e:            # noqa: BLE001
+                    from ..errors import error_code
+                    rt._error_counts[
+                        (type(e).__name__, error_code(e))] += 1
+                    fl = getattr(rt, "_flight", None)
+                    if fl is not None:
+                        fl.event("bridge_callback_error",
+                                 cls=type(e).__name__,
+                                 code=error_code(e), sub=ev.sub_id,
+                                 message=str(e))
                 n += 1
                 continue
             bdef = self._subs.get(ev.sub_id)

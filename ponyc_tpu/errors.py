@@ -67,6 +67,15 @@ ERROR_CODES = {
     "PoisonError": 11,           # supervise.py — deterministic poison:
     #   the same coded error at the same world position twice; the
     #   supervisor refuses to restart-loop on it
+    "FrameError": 12,            # serve.py — malformed ingress frame
+    #   (bad length prefix / non-word body); doubles as the wire
+    #   BADFRAME reply status of the serving front door
+    "ServeBusyError": 13,        # serve.py — admission shed at the
+    #   edge (overload, drain, or a choked slow-consumer connection);
+    #   doubles as the wire BUSY reply status — clients back off
+    "ServeDeadlineError": 14,    # serve.py — a request's deadline
+    #   expired before the device could serve it; the wire DEADLINE
+    #   reply status
 }
 
 

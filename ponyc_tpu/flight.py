@@ -190,6 +190,12 @@ class FlightRecorder:
             "controller": (None if ctrl is None else {
                 **ctrl.snapshot(),
                 "recent": ctrl.recent_decisions()}),
+            # Serving front door (ISSUE 9): shed rate / queue depth /
+            # admission limit / egress backlog — the overload half of
+            # a service postmortem (None when no Server is attached).
+            "serving": (rt._serve.stats()
+                        if getattr(rt, "_serve", None) is not None
+                        else None),
             "watchdog": (None if wd is None else wd.snapshot()),
             "options": dataclasses.asdict(rt.opts)
             if getattr(rt, "opts", None) is not None else {},
@@ -399,6 +405,21 @@ def render_postmortem(pm: Dict[str, Any]) -> str:
                 f"  step={w['step']} ticks={w['ticks']}/{w['budget']} "
                 f"gap={w['gap_us']}us occ={w['occ_sum']} "
                 f"qw_p99={w['qw_p99']} flags={_fmt_flags(w['flags'])}")
+    srv = pm.get("serving")
+    if srv:
+        sh = srv.get("shed") or {}
+        lines.append(
+            f"serving: frames={srv.get('frames')} "
+            f"accepted={srv.get('accepted')} "
+            f"replied={srv.get('replied')} "
+            f"shed={srv.get('shed_total')} "
+            f"(rate {srv.get('shed_rate')}; "
+            + ", ".join(f"{k}={v}" for k, v in sorted(sh.items()))
+            + f") queue={srv.get('queue')} "
+            f"inflight={srv.get('inflight')} "
+            f"admit_limit={(srv.get('admission') or {}).get('limit')} "
+            f"net_pending={srv.get('net_pending_bytes')}B"
+            + (" DRAINING" if srv.get("draining") else ""))
     mail = pm.get("host_mail") or []
     if mail:
         lines.append("recent host mail: " + ", ".join(
@@ -460,6 +481,13 @@ def diagnose_postmortem(pm: Dict[str, Any]) -> Tuple[str, str]:
             and "STALLED" in line:
         line += (f"; {last['occ_sum']} message(s) still queued "
                  f"(deepest {last['occ_max']})")
+    srv = pm.get("serving")
+    if srv and line.startswith(("STALLED", "CRASHED")):
+        # Serving-aware verdict (ISSUE 9): was the front door shedding
+        # (edge held) and how much reply backlog died with the world?
+        line += (f"; serving: shed_rate={srv.get('shed_rate')} "
+                 f"inflight={srv.get('inflight')} "
+                 f"net_pending={srv.get('net_pending_bytes')}B")
     ck = pm.get("checkpoint")
     if ck and ck.get("path") and line.startswith(("STALLED", "CRASHED")):
         # The doctor's recovery pointer: what the supervisor would

@@ -42,6 +42,11 @@ from .flight import ARMED_PHASES
 # re-fetching the behaviour matrix per window would tax the boundary).
 REFRESH_S = 0.5
 
+# Consecutive snapshots over which monotonically-growing net egress
+# backlog (Net.pending_total) flips /healthz to degraded: a consumer
+# has stopped reading and the per-connection buffers only grow.
+PENDING_WINDOW = 5
+
 
 # ---- snapshotting (run-loop thread only: may fetch device counters) ----
 
@@ -89,6 +94,15 @@ def snapshot(rt) -> Dict[str, Any]:
     snap["run_loop"] = rt.run_loop_stats()
     snap["queues"] = {"inject": len(rt._inject_q),
                       "fast": len(rt._host_fast_q)}
+    net = getattr(rt, "net", None)
+    if net is not None:
+        # Egress backpressure (ISSUE 9 satellite): unflushed bytes
+        # across every live connection — host attribute walk, no device.
+        snap["net"] = {"pending_bytes": int(net.pending_total()),
+                       "conns": len(net._conns)}
+    srv = getattr(rt, "_serve", None)
+    if srv is not None:
+        snap["serving"] = srv.stats()
     snap["errors"] = [
         {"class": cls, "code": int(code), "count": int(n)}
         for (cls, code), n in sorted(rt._error_counts.items())]
@@ -122,11 +136,20 @@ def health(rt) -> Dict[str, Any]:
             for (cls, code), n in getattr(rt, "_error_counts",
                                           {}).items()]
         drops = snap.get("drops") or {}
+        pend = list(mx._pending_hist) if mx is not None else []
+        pend_growing = (len(pend) >= PENDING_WINDOW
+                        and all(b > a for a, b in zip(pend, pend[1:]))
+                        and pend[-1] > 0)
         if errs:
             e = errs[-1]
             status = "degraded"
             reason = (f"{sum(x['count'] for x in errs)} coded error(s) "
                       f"recorded (latest {e['class']}, code {e['code']})")
+        elif pend_growing:
+            status = "degraded"
+            reason = (f"egress backpressure: net pending bytes grew "
+                      f"monotonically across {len(pend)} snapshots "
+                      f"(now {pend[-1]}) — a consumer stopped reading")
         elif any(int(v) for v in drops.values()):
             status = "degraded"
             reason = "telemetry ring drops: " + ", ".join(
@@ -240,6 +263,49 @@ def prometheus_text(snap: Dict[str, Any],
     if q:
         fam("pony_tpu_queue_depth", "gauge", "Host-side queue depths",
             [({"queue": k}, v) for k, v in sorted(q.items())])
+    net = snap.get("net") or {}
+    if net:
+        fam("pony_tpu_net_pending_bytes", "gauge",
+            "Unflushed egress bytes across all connections "
+            "(Net.pending backpressure signal)",
+            [(None, net.get("pending_bytes", 0))])
+        fam("pony_tpu_net_conns", "gauge", "Live net-layer connections",
+            [(None, net.get("conns", 0))])
+    srv = snap.get("serving") or {}
+    if srv:
+        fam("pony_tpu_serve_frames_total", "counter",
+            "Request frames received by the front door",
+            [(None, srv.get("frames", 0))])
+        fam("pony_tpu_serve_accepted_total", "counter",
+            "Requests admitted past the edge",
+            [(None, srv.get("accepted", 0))])
+        fam("pony_tpu_serve_replied_total", "counter",
+            "OK replies delivered", [(None, srv.get("replied", 0))])
+        fam("pony_tpu_serve_shed_total", "counter",
+            "Requests shed at the edge, by reason",
+            [({"reason": k}, v)
+             for k, v in sorted((srv.get("shed") or {}).items())])
+        fam("pony_tpu_serve_badframe_total", "counter",
+            "Malformed ingress frames",
+            [(None, srv.get("badframe", 0))])
+        fam("pony_tpu_serve_inflight", "gauge",
+            "Requests on the device right now",
+            [(None, srv.get("inflight", 0))])
+        fam("pony_tpu_serve_queue_depth", "gauge",
+            "Admitted requests awaiting a worker",
+            [(None, srv.get("queue", 0))])
+        adm = srv.get("admission") or {}
+        if adm:
+            fam("pony_tpu_serve_admit_limit", "gauge",
+                "Admission controller concurrency limit",
+                [(None, adm.get("limit", 0))])
+        lat = srv.get("latency_us") or {}
+        if lat.get("n"):
+            fam("pony_tpu_serve_latency_us", "gauge",
+                "End-to-end request latency percentiles (us, host "
+                "clock, bounded reservoir)",
+                [({"quantile": "0.5"}, lat["p50"]),
+                 ({"quantile": "0.99"}, lat["p99"])])
     drops = snap.get("drops") or {}
     if drops:
         fam("pony_tpu_ring_drops_total", "counter",
@@ -324,6 +390,11 @@ class MetricsServer:
         self.rt = rt
         self._snap: Dict[str, Any] = {}
         self._last_full = 0.0
+        # Net egress-backlog trail: one reading per snapshot refresh;
+        # health() flips to degraded when it grows monotonically
+        # across the whole window (a consumer stopped reading).
+        import collections as _c
+        self._pending_hist: "_c.deque" = _c.deque(maxlen=PENDING_WINDOW)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)),
                                           _Handler)
         self._httpd.daemon_threads = True
@@ -338,6 +409,9 @@ class MetricsServer:
         """Force a full snapshot refresh (run start/end, stop())."""
         try:
             self._snap = snapshot(rt)
+            if "net" in self._snap:
+                self._pending_hist.append(
+                    int(self._snap["net"]["pending_bytes"]))
         except Exception:        # noqa: BLE001 — teardown must not raise
             pass
         self._last_full = time.monotonic()
@@ -393,12 +467,30 @@ def diagnose_endpoint(url: str, timeout_s: float = 5.0
         bits.append(hz["reason"])
     line = f"{status.upper()}: " + "; ".join(bits)
     keys = ("pony_tpu_processed_total", "pony_tpu_delivered_total",
-            "pony_tpu_windows_total", "pony_tpu_window_length")
+            "pony_tpu_windows_total", "pony_tpu_window_length",
+            # Serving front door (serve.py), when attached.
+            "pony_tpu_serve_frames_total",
+            "pony_tpu_serve_accepted_total",
+            "pony_tpu_serve_replied_total",
+            "pony_tpu_serve_admit_limit",
+            "pony_tpu_net_pending_bytes")
     detail_lines = [f"endpoint: {url}"]
     for k in keys:
         v = parsed.get((k, ()))
         if v is not None:
             detail_lines.append(f"{k} = {int(v)}")
+    # Serving verdict colour: shed volume by reason + the shed rate —
+    # the first thing an overload postmortem wants to know.
+    sheds = {lab: v for (name, lab), v in parsed.items()
+             if name == "pony_tpu_serve_shed_total"}
+    if sheds:
+        total_shed = int(sum(sheds.values()))
+        frames = parsed.get(("pony_tpu_serve_frames_total", ()), 0)
+        rate = total_shed / frames if frames else 0.0
+        detail_lines.append(
+            f"serve shed: {total_shed} ({rate:.1%} of frames; "
+            + ", ".join(f"{dict(lab).get('reason', '?')}={int(v)}"
+                        for lab, v in sorted(sheds.items())) + ")")
     for (name, labels), v in sorted(parsed.items()):
         if name == "pony_tpu_errors_total":
             lab = ", ".join(f"{k}={x}" for k, x in labels)

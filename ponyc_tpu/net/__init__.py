@@ -292,6 +292,14 @@ class Net:
         c = self._conns.get(cid)
         return c.outbuf_len if c is not None else 0
 
+    def pending_total(self) -> int:
+        """Unflushed outgoing bytes across ALL connections — the
+        layer-wide egress-backpressure gauge (`/metrics` exports it as
+        pony_tpu_net_pending_bytes; /healthz degrades when it grows
+        monotonically across snapshots: a consumer has stopped
+        reading)."""
+        return sum(c.outbuf_len for c in self._conns.values())
+
     def set_conn_owner(self, cid: int, owner: int, *,
                        on_data: BehaviourDef,
                        on_closed: BehaviourDef) -> None:

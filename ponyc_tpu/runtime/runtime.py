@@ -271,6 +271,12 @@ class Runtime:
         # (class_name, int code) — the errors.ERROR_CODES metrics label
         # and the postmortem's error section.
         self._error_counts: collections.Counter = collections.Counter()
+        self._last_aux = None         # newest RETIRED window's host-side
+        #   StepAux (numpy scalars): the zero-extra-fetch telemetry feed
+        #   for edge consumers — the serving tier's admission controller
+        #   (serve.py) reads qw_p99/n_muted_now here
+        self._serve = None            # serve.Server when a front door is
+        #   attached (metrics/flight surface the serving block)
 
     # Any state assignment — including a driver pushing rt._step results
     # back, as bench.py does — conservatively invalidates the cached
@@ -1356,6 +1362,7 @@ class Runtime:
         # epoch moved) — such a write is invisible to this aux.
         if self._state_epoch == win["epoch"]:
             self._device_dirty = False
+        self._last_aux = a
         self.steps_run += k
         if self.opts.debug_checks:
             self.check_invariants()

@@ -124,6 +124,28 @@ def test_bench_checkpoint_smoke_block(bench_mod):
     assert c["restore_fast_start_s"] < 30
 
 
+def test_bench_serve_smoke_block(bench_mod):
+    """The --serve-smoke `serving` block (ISSUE 9, PROFILE.md §13):
+    the real socket front door under ~2x-capacity concurrent demand —
+    requests are shed at the edge with BUSY, p50/p99 of ADMITTED
+    requests recorded, every frame answered, and the mailbox rings
+    never hit a sticky-fail state."""
+    s = bench_mod.bench_serve_smoke(_args(), delivery="plan",
+                                    fused=False)
+    assert s["rings_ok"], s              # no SpillOverflow/SpawnFail
+    assert s["rings_sticky_fail"] == {}
+    assert s["drained_ok"], s
+    assert s["shed_ok"], s               # overload really shed BUSY
+    assert s["replies_accounted"], s     # zero unanswered requests
+    assert s["ok"] > 0 and s["busy"] > 0
+    assert s["bad_value"] == 0
+    assert s["p99_us"] > s["p50_us"] > 0
+    assert s["goodput_rps"] > 0
+    assert s["overload_x"] >= 2.0        # sustained >= 2x overload
+    assert s["admission"]["limit"] >= 1
+    assert s["batches"] >= 1 and s["submitted"] >= s["ok"]
+
+
 def test_tpu_env_details_shape(bench_mod):
     """The tpu_init_error env snapshot: JSON-serialisable, secrets
     filtered, libtpu presence probed."""

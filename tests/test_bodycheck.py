@@ -508,7 +508,11 @@ def test_shipped_trees_lint_clean_pure_ast():
          # the supervisor, and the chaos harness
          os.path.join(ROOT, "ponyc_tpu", "serialise.py"),
          os.path.join(ROOT, "ponyc_tpu", "supervise.py"),
-         os.path.join(ROOT, "ponyc_tpu", "testing.py")])
+         os.path.join(ROOT, "ponyc_tpu", "testing.py"),
+         # serving front door (ISSUE 9): the ingress tier's actor
+         # types (Egress/FrontDoor/ServeWorker) and the load generator
+         os.path.join(ROOT, "ponyc_tpu", "serve.py"),
+         os.path.join(ROOT, "ponyc_tpu", "loadgen.py")])
     dt = time.perf_counter() - t0
     assert findings == [], "\n".join(str(f) for f in findings)
     assert n_types >= 25 and n_beh >= 35

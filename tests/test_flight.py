@@ -134,7 +134,10 @@ def test_error_code_table_is_stable():
         "CapabilityError": 5, "VerifyError": 6, "PonyStallError": 7,
         # Durable worlds (ISSUE 8) — codes are append-only.
         "SnapshotCorruptError": 8, "SnapshotFormatError": 9,
-        "SnapshotGeometryError": 10, "PoisonError": 11}
+        "SnapshotGeometryError": 10, "PoisonError": 11,
+        # Serving front door (ISSUE 9) — wire reply statuses too.
+        "FrameError": 12, "ServeBusyError": 13,
+        "ServeDeadlineError": 14}
 
 
 def test_error_classes_expose_codes():
