@@ -45,9 +45,11 @@ attribute the ticks, so the BENCH trajectory records where the time
 went, not just totals. The timed headline pass itself stays level 0.
 
 Usage: python bench.py  [--actors N] [--ticks K] [--platform auto|tpu|cpu]
-                        [--delivery auto|plan|cosort] [--fused auto|on|off]
+                        [--delivery auto|plan|cosort|pallas_mega]
+                        [--fused auto|on|off]
                         [--trace-smoke] [--metrics-smoke]
                         [--checkpoint-smoke] [--serve-smoke]
+                        [--kernel-smoke] [--no-fallback]
 
 --trace-smoke adds a `tracing` block: one sampled causal-tracing pass
 (analysis=3, trace_sample=1, PROFILE.md §10) reassembled and checked
@@ -68,9 +70,16 @@ an explicit `tpu_init_error` with the probed env snapshot (`tpu_env`)
 PLUS a flight-recorder `postmortem` (probe timeline + env) and the
 doctor's one-line diagnosis on stderr, so CPU-fallback rounds carry
 their stall evidence (`doctor --postmortem BENCH_rNN.json`).
+--no-fallback makes that failure fatal (exit 1 with the postmortem in
+the JSON) instead of publishing a CPU number. Every run embeds a
+`kernel` block with the packed bytes/msg model (ops/megakernel.py) at
+the measured escape rate; --kernel-smoke extends it with a bit-for-bit
+plan-vs-pallas_mega A/B on a small world (PROFILE.md §14).
 Env:   PONY_TPU_BENCH_ACTORS / PONY_TPU_BENCH_TICKS /
        PONY_TPU_BENCH_PLATFORM / PONY_TPU_BENCH_ALLOW_CPU /
-       PONY_TPU_BENCH_DELIVERY / PONY_TPU_BENCH_FUSED override;
+       PONY_TPU_BENCH_DELIVERY / PONY_TPU_BENCH_FUSED /
+       PONY_TPU_BENCH_KERNEL_SMOKE override; PONY_TPU_MEGA_AUTO=1 is
+       set by main() so delivery=auto enumerates the megakernel;
        PONY_TPU_TUNING_CACHE / PONY_TPU_COMPILE_CACHE relocate ("off"
        disables) the persistent caches.
 """
@@ -169,6 +178,17 @@ def tristate(v):
     return v in ("1", "true", "yes", "on")
 
 
+def cpu_fallback_allowed(no_fallback: bool) -> bool:
+    """CPU-fallback policy for --platform auto: --no-fallback (or the
+    legacy PONY_TPU_BENCH_ALLOW_CPU=0 kill switch) makes a failed TPU
+    init exit non-zero with the probe postmortem instead of quietly
+    publishing a CPU number — a TPU regression must never masquerade
+    as a (slower) healthy run."""
+    if no_fallback:
+        return False
+    return os.environ.get("PONY_TPU_BENCH_ALLOW_CPU", "1") != "0"
+
+
 def bench_ubench(args):
     import jax
     import jax.numpy as jnp
@@ -217,7 +237,16 @@ def bench_ubench(args):
 
     processed = rt.counter("n_processed") & 0xFFFFFFFF
     expect = (warm_windows * K + ticks) * args.actors * pings
+    # The bandwidth-diet model at this run's MEASURED escape rate
+    # (ops/megakernel.py): packed bytes per ring record on the hot
+    # path — recorded in every run so the standing telemetry shows
+    # whether real payloads stay inside the int16 lanes.
+    from ponyc_tpu.ops import megakernel as _mk
+    bytes_model = _mk.modelled_bytes_per_msg(
+        rt.opts, _mk.escape_rate_state(rt.state))
     return {
+        "packed_bytes_per_msg": bytes_model["packed_bytes"],
+        "bytes_model": bytes_model,
         "msgs_per_sec": args.actors * pings * ticks / elapsed,
         "pings": pings,
         "elapsed_s": elapsed,
@@ -233,6 +262,70 @@ def bench_ubench(args):
         "delivery": rt.opts.delivery,
         "pallas": rt.opts.pallas,
         "pallas_fused": rt.opts.pallas_fused,
+    }
+
+
+def bench_kernel_smoke(args):
+    """The --kernel-smoke `kernel` A/B block (PROFILE.md §14): the same
+    seeded ubench world advanced through the XLA window
+    (delivery="plan") and through the persistent fused window
+    megakernel (delivery="pallas_mega"), compared BIT-FOR-BIT over
+    every state leaf, with per-variant in-executable tick timings and
+    the bandwidth-diet model at the measured escape rate. On CPU the
+    megakernel runs interpreted — there the timing is a wiring check,
+    not a perf claim (`interpret: true` in the block says so)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ponyc_tpu import RuntimeOptions, serialise
+    from ponyc_tpu.models import ubench
+    from ponyc_tpu.ops import megakernel as mk
+
+    actors = max(4, min(args.actors, 64))    # interpret-mode friendly
+    pings = args.pings
+    cap = ubench.cap_for_pings(pings, floor=args.cap)
+    ticks = max(2, min(args.ticks, 16))
+    K = max(1, min(args.fuse, ticks))
+    windows = max(1, ticks // K)
+    tick_ms = {}
+    named = {}
+    esc_rate = 0.0
+    for delivery in ("plan", "pallas_mega"):
+        opts = RuntimeOptions(mailbox_cap=cap, batch=pings, max_sends=1,
+                              msg_words=1, spill_cap=64, inject_slots=8,
+                              delivery=delivery)
+        rt, ids = ubench.build(actors, opts, pings=pings)
+        # Representative small-payload traffic: hops fits the int16
+        # lane (and outlives the smoke's few ticks), so the diet model
+        # here shows the packed ratio on clean payloads. The headline
+        # run keeps its ~2^30 hops counter and records the honest
+        # (escape-heavy) rate for THAT traffic in detail/bytes_model.
+        ubench.seed_all(rt, ids, hops=1 << 12, pings=pings)
+        st, inj = rt.state, rt._empty_inject
+        limit = jnp.int32(K)
+        st, aux, _k = rt._multi(st, *inj, limit)      # pays the jit
+        jax.block_until_ready(aux)
+        t0 = time.time()
+        for _ in range(windows):
+            st, aux, _k = rt._multi(st, *inj, limit)
+        jax.block_until_ready(aux)
+        rt.state = st
+        tick_ms[delivery] = round(
+            1e3 * (time.time() - t0) / (windows * K), 4)
+        named[delivery] = serialise._named_state_arrays(rt.state)
+        esc_rate = mk.escape_rate_state(rt.state)
+        model_opts = rt.opts
+    a, b = named["plan"], named["pallas_mega"]
+    mismatched = [k for k in a if not np.array_equal(np.asarray(a[k]),
+                                                     np.asarray(b[k]))]
+    return {
+        "equal_ok": not mismatched,
+        "mismatched": mismatched[:4],
+        "tick_ms": tick_ms,
+        "interpret": mk.interpret_mode(),
+        "actors": actors,
+        "ticks": (windows + 1) * K,
+        "bytes_per_msg": mk.modelled_bytes_per_msg(model_opts, esc_rate),
     }
 
 
@@ -693,10 +786,12 @@ def main():
     ap.add_argument("--delivery",
                     default=os.environ.get("PONY_TPU_BENCH_DELIVERY",
                                            "auto"),
-                    choices=["plan", "cosort", "auto"],
+                    choices=["plan", "cosort", "pallas_mega", "auto"],
                     help="delivery formulation; 'auto' (default) "
-                    "calibrates plan vs cosort in-executable at start "
-                    "and records the table in the JSON (tuning.py)")
+                    "calibrates plan vs cosort (and the pallas_mega "
+                    "persistent window kernel where eligible) "
+                    "in-executable at start and records the table in "
+                    "the JSON (tuning.py)")
     ap.add_argument("--fused", nargs="?", const="on",
                     default=os.environ.get("PONY_TPU_BENCH_FUSED", "0"),
                     choices=["on", "off", "auto", "0", "1"],
@@ -740,6 +835,21 @@ def main():
                     "(ckpt_cost_us_per_window), per-checkpoint capture/"
                     "write costs, and restore-fast-start time — "
                     "embedded as a `checkpoint` block (PROFILE.md §12)")
+    ap.add_argument("--no-fallback", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_ALLOW_CPU", "1") == "0",
+                    help="with --platform auto, exit non-zero (with "
+                    "the flight-recorder probe postmortem in the "
+                    "JSON) when TPU init fails, instead of quietly "
+                    "publishing a CPU-fallback number")
+    ap.add_argument("--kernel-smoke", action="store_true",
+                    default=os.environ.get(
+                        "PONY_TPU_BENCH_KERNEL_SMOKE", "0") == "1",
+                    help="megakernel A/B smoke: the same seeded world "
+                    "through delivery=plan and delivery=pallas_mega, "
+                    "compared bit-for-bit, with per-variant tick "
+                    "timings and the packed bytes/msg model — "
+                    "embedded as the `kernel` block (PROFILE.md §14)")
     ap.add_argument("--serve-smoke", action="store_true",
                     default=os.environ.get(
                         "PONY_TPU_BENCH_SERVE_SMOKE", "0") == "1",
@@ -753,7 +863,11 @@ def main():
     args.warmup = max(1, args.warmup)   # the first step pays the jit
     args.lat_ticks = max(1, args.lat_ticks)
 
-    allow_cpu = os.environ.get("PONY_TPU_BENCH_ALLOW_CPU", "1") != "0"
+    allow_cpu = cpu_fallback_allowed(args.no_fallback)
+    # BENCH runs always enumerate the persistent megakernel in the
+    # delivery=auto A/B table (ops/megakernel.auto_enumerable gates it
+    # off by default on CPU so the unit suite stays lean):
+    os.environ.setdefault("PONY_TPU_MEGA_AUTO", "1")
     tpu_error = None
     tpu_pm = None        # flight-recorder postmortem of a failed init
     # Backend init wall-time: probe + first jax.devices(), the number
@@ -868,6 +982,15 @@ def main():
                 args, delivery=ub["delivery"], fused=ub["pallas_fused"])
         except Exception as e:                   # noqa: BLE001
             serving_block = {"error": str(e)}
+    # Megakernel block (PROFILE.md §14): the bandwidth-diet model at
+    # the headline run's measured escape rate rides EVERY json;
+    # --kernel-smoke adds the bit-for-bit plan-vs-pallas_mega A/B.
+    kernel_block = {"bytes_per_msg": ub["bytes_model"]}
+    if args.kernel_smoke:
+        try:
+            kernel_block.update(bench_kernel_smoke(args))
+        except Exception as e:                   # noqa: BLE001
+            kernel_block["error"] = str(e)
     msgs_per_sec = ub["msgs_per_sec"]
 
     result = {
@@ -887,6 +1010,7 @@ def main():
             "elapsed_s": round(ub["elapsed_s"], 4),
             "tick_ms": round(ub["tick_ms"], 3),
             "processed_counter_ok": ub["processed_counter_ok"],
+            "packed_bytes_per_msg": ub["packed_bytes_per_msg"],
             "build_s": round(ub["build_s"], 1),
             "warmup_s": round(ub["warmup_s"], 1),
             "platform": plat,
@@ -909,6 +1033,10 @@ def main():
         # synchronous loop through the real Runtime.run() (PROFILE.md
         # §9) — the standing record of this PR's win.
         "run_loop": run_loop,
+        # Persistent megakernel + mailbox bandwidth diet (PROFILE.md
+        # §14): packed bytes/msg model at the measured escape rate,
+        # plus the --kernel-smoke bit-for-bit A/B when requested.
+        "kernel": kernel_block,
     }
     if tracing_block is not None:
         result["tracing"] = tracing_block

@@ -56,6 +56,12 @@ CSV_COLUMNS = [
     # windows dispatched behind an in-flight one), and the controller's
     # next window length + state (grow/shrink/steady).
     "window_ticks", "host_gap_us", "ctrl_window", "ctrl_state",
+    # Mailbox bandwidth diet (ops/megakernel.py): bytes per ring record
+    # at this run's delivery formulation — 2 bytes/word inside the
+    # pallas_mega packed kernel boundary, 4 bytes/word on the int32 XLA
+    # paths. Static per run; rides every row so downstream tooling can
+    # turn msgs/s into bytes/s without re-deriving the layout.
+    "bytes_msg",
 ]
 
 
@@ -129,6 +135,12 @@ class Analysis:
         from .runtime.state import QW_BUCKETS
         self._prev_hist = np.zeros((len(self.dev_names), QW_BUCKETS),
                                    np.int64)
+        # Packed-record width for the bytes_msg column (see
+        # CSV_COLUMNS): int16 lanes inside the megakernel boundary,
+        # int32 words everywhere else.
+        from .ops.megakernel import record_words
+        self.bytes_msg = record_words(rt.opts) * (
+            2 if rt.opts.delivery == "pallas_mega" else 4)
         if self.level >= 2:
             self._writer = threading.Thread(target=self._write_loop,
                                             daemon=True)
@@ -203,6 +215,7 @@ class Analysis:
             0 if gap_us is None else round(float(gap_us), 1),
             0 if ctrl is None else int(ctrl.window),
             "-" if ctrl is None else ctrl.state,
+            self.bytes_msg,
         ])
         for g in range(runs.shape[0]):
             row.append(self._delta(f"run:{g}", int(runs[g])))
@@ -703,7 +716,9 @@ def top_frame(csv_path: str) -> str:
                  f"({iv(last, 'processed') / dt_s:,.0f}/s)  "
                  f"delivered {iv(last, 'delivered')}  "
                  f"rejected {iv(last, 'rejected')}  "
-                 f"deadletter {iv(last, 'deadletter')}")
+                 f"deadletter {iv(last, 'deadletter')}"
+                 + (f"  bytes/msg {iv(last, 'bytes_msg')}"
+                    if iv(last, "bytes_msg") else ""))
     lines.append(f"queue:  occ_sum {iv(last, 'occ_sum')}  "
                  f"occ_max {iv(last, 'occ_max')}  "
                  f"muted {iv(last, 'muted_now')}  "

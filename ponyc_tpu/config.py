@@ -190,11 +190,24 @@ class RuntimeOptions:
     #   "cosort" — one stable multi-operand lax.sort per tick that moves
     #              the payload with the key (no plan, no gathers; wins
     #              where arbitrary lane gathers lower poorly);
-    #   "auto"   — calibrate both at Runtime.start() by timing a short
-    #              in-executable fused window per formulation on the
-    #              program's real cohort shapes and keep the faster one
-    #              (tuning.py; the decision persists in the tuning
-    #              cache so steady-state starts skip calibration).
+    #   "pallas_mega" — the persistent fused window megakernel
+    #              (ops/megakernel.py, PROFILE.md §14): the WHOLE gated
+    #              window — delivery gather, mailbox drain, dispatch,
+    #              profiler lanes — runs as one Pallas kernel with the
+    #              in-window while as a kernel-internal loop, and ring
+    #              records cross the kernel boundary packed into int16
+    #              lanes + an int32 escape plane (the mailbox bandwidth
+    #              diet). Plan-formulation delivery semantics,
+    #              bit-equivalent by construction; ineligible programs
+    #              (mesh shards > 1, pallas/pallas_fused forced on)
+    #              fall back to the XLA spelling.
+    #   "auto"   — calibrate the formulations at Runtime.start() by
+    #              timing a short in-executable fused window per
+    #              formulation on the program's real cohort shapes and
+    #              keep the faster one (tuning.py; the decision
+    #              persists in the tuning cache so steady-state starts
+    #              skip calibration; pallas_mega joins the candidates
+    #              on TPU, or under PONY_TPU_MEGA_AUTO=1 elsewhere).
     debug_checks: bool = False     # run Runtime.check_invariants() at
     #   every aux fetch (≙ the reference's debug-build queue checkers,
     #   actor.c:57-92; costly — test/debug only)
@@ -298,8 +311,10 @@ class RuntimeOptions:
             raise ValueError("msg_words must be >= 1")
         if self.batch < 1:
             raise ValueError("batch must be >= 1")
-        if self.delivery not in ("plan", "cosort", "auto"):
-            raise ValueError("delivery must be 'plan', 'cosort' or 'auto'")
+        if self.delivery not in ("plan", "cosort", "pallas_mega",
+                                 "auto"):
+            raise ValueError("delivery must be 'plan', 'cosort', "
+                             "'pallas_mega' or 'auto'")
         if isinstance(self.quiesce_interval, str):
             if self.quiesce_interval != "auto":
                 raise ValueError(

@@ -1,2 +1,5 @@
-"""Low-level ops: payload packing, segment primitives, (later) Pallas
-kernels for the dispatch/delivery hot path."""
+"""Low-level ops: payload packing, segment primitives, and the Pallas
+kernels for the dispatch/delivery hot path — mailbox_kernel (drain),
+fused_dispatch (drain+behaviour+outbox), megakernel (the whole gated
+window in one persistent kernel + the int16/escape-plane record
+codec, PROFILE.md §14)."""

@@ -2096,11 +2096,32 @@ def build_multi_step_gated(program: Program, opts: RuntimeOptions):
     window); a gated-out window consumes none (ticks_run == 0 tells the
     host to re-queue them).
     Returns (state, last_aux, ticks_run).
+
+    delivery="pallas_mega" (PROFILE.md §14): the whole window body runs
+    as ONE persistent Pallas kernel (ops/megakernel.py) instead of the
+    XLA while-loop below — same step closure, same gate, bit-equivalent
+    by construction; ineligible programs (mesh shards, nested Pallas
+    kernels on) fall through to the XLA spelling with plan-formulation
+    delivery.
     """
     step = build_step(program, opts)
+    if opts.delivery == "pallas_mega":
+        from ..ops import megakernel
+        if megakernel.eligible(program, opts):
+            return megakernel.build_mega_window(program, opts, step,
+                                                aux_go)
 
     def multi(st: RtState, inject_tgt, inject_words, limit, force,
               prev_aux: StepAux):
+        # BENCH_r05 fix: the run loop redispatches this executable with
+        # the SAME inject sentinels / limit every window, and XLA was
+        # observed re-running constant folding over the window body per
+        # dispatch when those operands fold to literals (the r05 tail
+        # stall). The barrier pins them as runtime values — the loop
+        # body compiles once, folding stops at this line.
+        inject_tgt, inject_words, limit, force = lax.optimization_barrier(
+            (inject_tgt, inject_words, limit, force))
+
         def cond(carry):
             _st, aux, i = carry
             first = i == 0
@@ -2165,8 +2186,24 @@ def build_forced_window(program: Program, opts: RuntimeOptions):
     timings carry an ~11 ms launch floor on the tunnelled chip).
     Injections are applied every tick (the tuner passes the empty
     inject). Same signature family as build_multi_step so
-    _jit_over_mesh wraps it identically."""
+    _jit_over_mesh wraps it identically.
+
+    delivery="pallas_mega" delegates to the megakernel's forced
+    spelling (ops/megakernel.py) so calibration times the kernel on
+    exactly the trip count every other variant runs."""
     step = build_step(program, opts)
+    if opts.delivery == "pallas_mega":
+        from ..ops import megakernel
+        if megakernel.eligible(program, opts):
+            mega = megakernel.build_mega_window(program, opts, step,
+                                                aux_go, forced=True)
+
+            def forced_mega(st: RtState, inject_tgt, inject_words,
+                            limit):
+                return mega(st, inject_tgt, inject_words, limit,
+                            jnp.bool_(True), zero_aux())
+
+            return forced_mega
 
     def forced(st: RtState, inject_tgt, inject_words, limit):
         def body(_i, carry):

@@ -294,6 +294,17 @@ class RtState:
     type_state: Dict[str, Dict[str, jnp.ndarray]]
 
 
+# The int32 word tables eligible for the narrow-dtype "bandwidth diet"
+# (ops/megakernel.py): mailbox ring records, both spill word tables and
+# the per-message trace lanes. These are the hot-path bytes-per-message
+# — behaviour ids and small payload words travel as int16 lanes with an
+# int32 escape plane at the megakernel boundary, and serialise.py can
+# store snapshots in the same packed form (save(packed=True)). Listed
+# here, next to the layout they describe, so the kernel boundary and the
+# snapshot codec can never disagree about WHICH tables pack.
+PACKED_WORD_FIELDS = ("buf", "dspill_words", "rspill_words", "trace_buf")
+
+
 def init_state(program: Program, opts: RuntimeOptions) -> RtState:
     """Allocate the zeroed actor world for a finalized program."""
     assert program.frozen, "finalize() the Program first"

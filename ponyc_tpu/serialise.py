@@ -171,6 +171,58 @@ def _state_from_named(template, arrays: Dict[str, np.ndarray]):
     return dataclasses.replace(template, **kw)
 
 
+def _is_word_table(name: str) -> bool:
+    from .runtime.state import PACKED_WORD_FIELDS
+    return any(name == f"st.{f}" or name.startswith(f"st.{f}.")
+               for f in PACKED_WORD_FIELDS)
+
+
+def pack_snapshot_arrays(arrays: Dict[str, np.ndarray],
+                         ) -> Dict[str, np.ndarray]:
+    """The snapshot spelling of the mailbox bandwidth diet
+    (ops/megakernel.py): every int32 word table (mailbox rings, spill
+    words, trace lanes — state.PACKED_WORD_FIELDS) is stored as an
+    int16 lane plane (`<name>.lo16`) plus an int32 escape plane
+    (`<name>.esc32`). The codec is lossless, so a packed snapshot
+    restores bit-identically; the escape plane compresses to almost
+    nothing when payloads are narrow (savez_compressed). `_load_raw`
+    decodes transparently — readers never see the planes."""
+    from .ops.megakernel import pack_words_np
+    out: Dict[str, np.ndarray] = {}
+    for name, a in arrays.items():
+        if _is_word_table(name) and a.dtype == np.int32:
+            lo16, esc32 = pack_words_np(a)
+            out[name + ".lo16"] = lo16
+            out[name + ".esc32"] = esc32
+        else:
+            out[name] = a
+    return out
+
+
+def _unpack_snapshot_arrays(arrays: Dict[str, np.ndarray],
+                            ) -> Dict[str, np.ndarray]:
+    """Decode `pack_snapshot_arrays` planes back into int32 tables
+    (no-op for unpacked snapshots — v3 stays one format, packing is an
+    encoding choice per save)."""
+    from .ops.megakernel import unpack_words_np
+    out: Dict[str, np.ndarray] = {}
+    for name, a in arrays.items():
+        if name.endswith(".lo16"):
+            base = name[:-len(".lo16")]
+            esc = arrays.get(base + ".esc32")
+            if esc is None:
+                raise SnapshotCorruptError(
+                    f"packed array {base!r} is missing its escape "
+                    "plane")
+            out[base] = unpack_words_np(a, esc)
+        elif name.endswith(".esc32") and (name[:-len(".esc32")]
+                                          + ".lo16") in arrays:
+            continue
+        else:
+            out[name] = a
+    return out
+
+
 def _take(arrays, name, like):
     arr = arrays.get(name)
     if arr is None:
@@ -301,10 +353,14 @@ def _chaos_point(point: str) -> None:
     testing.chaos.fire(point)
 
 
-def save(rt, path: str) -> None:
+def save(rt, path: str, packed: bool = False) -> None:
     """Snapshot the full world to `path` (.npz). Call between runs/steps
-    only (any queued-but-uninjected host sends are included)."""
+    only (any queued-but-uninjected host sends are included).
+    `packed=True` stores the word tables in the narrow-dtype form
+    (pack_snapshot_arrays) — restore is transparent and bit-exact."""
     header, arrays = capture(rt)
+    if packed:
+        arrays = pack_snapshot_arrays(arrays)
     write_snapshot(header, arrays, path)
 
 
@@ -364,7 +420,10 @@ def _load_raw(path: str):
                 raise SnapshotCorruptError(
                     f"{path}: snapshot truncated — missing arrays "
                     f"{sorted(missing)[:4]}")
-            return header, arrays
+            # Narrow-dtype stored snapshots (save(packed=True)) decode
+            # here, AFTER the CRC table verified the stored planes —
+            # every reader downstream sees plain int32 word tables.
+            return header, _unpack_snapshot_arrays(arrays)
     except (zipfile.BadZipFile, *_CORRUPT_EXC) as e:
         if isinstance(e, (SnapshotCorruptError, SnapshotFormatError)):
             raise

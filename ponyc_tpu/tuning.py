@@ -178,13 +178,26 @@ def fused_eligible(program, opts: RuntimeOptions) -> bool:
     return False
 
 
+def mega_eligible(program, opts: RuntimeOptions) -> bool:
+    """Whether delivery="auto" should time the window megakernel
+    (ops/megakernel.py): structurally eligible AND worth measuring on
+    this backend (on CPU the kernel only runs in interpret mode — a
+    correctness vehicle, never a perf winner — so auto skips it there
+    unless PONY_TPU_MEGA_AUTO=1; bench.py sets that so every BENCH
+    json's A/B table carries the variant)."""
+    from .ops import megakernel
+    return megakernel.auto_enumerable(program, opts)
+
+
 def variants(program, opts: RuntimeOptions) -> List[Tuple[str, Dict]]:
     """Ordered (name, overrides) candidates for the opts' "auto" fields.
     The first entry is the baseline (plan / kernels off); `decide`
     breaks ties toward earlier entries, so noise can never flip a dead
     heat away from the safe default."""
-    deliveries = (["plan", "cosort"] if opts.delivery == "auto"
-                  else [opts.delivery])
+    deliveries = (["plan", "cosort"]
+                  + (["pallas_mega"] if mega_eligible(program, opts)
+                     else [])
+                  if opts.delivery == "auto" else [opts.delivery])
     pallas_vals = ([False, True]
                    if opts.pallas == "auto" and pallas_eligible(program)
                    else [False if opts.pallas == "auto" else opts.pallas])
@@ -197,6 +210,15 @@ def variants(program, opts: RuntimeOptions) -> List[Tuple[str, Dict]]:
     for f in fused_vals:
         for p in pallas_vals:
             for d in deliveries:
+                if opts.delivery == "auto" and d == "pallas_mega" \
+                        and (p or f):
+                    # The megakernel IS the fused form of both nested
+                    # kernels — combining them would nest pallas_calls,
+                    # so auto never enumerates the combination. (A
+                    # FIXED delivery="pallas_mega" with a kernel forced
+                    # on stays listed: megakernel.eligible rejects it
+                    # and the engine falls back to the XLA spelling.)
+                    continue
                 name = d + ("+pallas" if p else "") + ("+fused" if f else "")
                 out.append((name, {"delivery": d, "pallas": p,
                                    "pallas_fused": f}))
@@ -243,7 +265,11 @@ def tuning_key(program, opts: RuntimeOptions) -> Dict[str, Any]:
         "inject_slots", "mesh_shards", "route_bucket", "mute_slots",
         "dispatch_gating", "blob_slots", "blob_words")}
     return {
-        "v": 1,
+        # v2: delivery="pallas_mega" joined the variant space (the
+        # window megakernel, ops/megakernel.py) — v1 records predate it
+        # and must recalibrate rather than transfer a two-way decision
+        # into a three-way race.
+        "v": 2,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "jax": jax.__version__,

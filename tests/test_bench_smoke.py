@@ -179,3 +179,45 @@ def test_tristate_parsing(bench_mod):
     assert bench_mod.tristate("1") is True
     assert bench_mod.tristate("off") is False
     assert bench_mod.tristate("0") is False
+
+
+def test_bench_kernel_smoke_block(bench_mod, monkeypatch):
+    """The --kernel-smoke `kernel` block (PR 11): the same seeded world
+    through the XLA window and the persistent megakernel must agree
+    bit-for-bit, both variants must produce a timing, and the bandwidth
+    diet must hit the ISSUE acceptance bar (ratio >= 1.8) on the
+    smoke's clean-payload traffic. On CPU the kernel runs interpreted
+    and the block says so."""
+    monkeypatch.delenv("PONY_TPU_MEGA_AUTO", raising=False)
+    k = bench_mod.bench_kernel_smoke(_args(actors=16, ticks=4, fuse=2))
+    assert k["equal_ok"], k["mismatched"]
+    assert k["tick_ms"]["plan"] > 0
+    assert k["tick_ms"]["pallas_mega"] > 0
+    bm = k["bytes_per_msg"]
+    assert bm["ratio"] >= 1.8
+    assert bm["packed_bytes"] < bm["unpacked_bytes"]
+    import jax
+    if jax.default_backend() != "tpu":
+        assert k["interpret"] is True
+
+
+def test_bench_ubench_records_packed_bytes(bench_mod):
+    """Every run — not just --kernel-smoke ones — carries the packed
+    record width so the standing telemetry can price msgs/s in bytes."""
+    ub = bench_mod.bench_ubench(_args(ticks=4, fuse=2))
+    bm = ub["bytes_model"]
+    assert ub["packed_bytes_per_msg"] == bm["packed_bytes"] > 0
+    assert bm["record_words"] == 2          # 1 target + msg_words=1
+    # ubench's ~2^30 hops counters escape the int16 lanes: the model
+    # must report the honest measured rate, not assume clean traffic.
+    assert 0.0 <= bm["escape_rate"] <= 1.0
+
+
+def test_cpu_fallback_policy(bench_mod, monkeypatch):
+    """--no-fallback beats the legacy env kill switch; default stays
+    allow (a degraded-but-recorded run beats no record at all)."""
+    monkeypatch.delenv("PONY_TPU_BENCH_ALLOW_CPU", raising=False)
+    assert bench_mod.cpu_fallback_allowed(False) is True
+    assert bench_mod.cpu_fallback_allowed(True) is False
+    monkeypatch.setenv("PONY_TPU_BENCH_ALLOW_CPU", "0")
+    assert bench_mod.cpu_fallback_allowed(False) is False

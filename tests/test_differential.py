@@ -164,6 +164,12 @@ CONFIGS = [
     ("fused-kernel", dict(mailbox_cap=4, batch=2, msg_words=1,
                           max_sends=2, spill_cap=512, inject_slots=16,
                           pallas_fused=True)),
+    # PR 11: the whole gated window as ONE persistent Pallas kernel
+    # (ops/megakernel.py, interpret mode on CPU) — must match the
+    # sequential oracle exactly, like every XLA formulation above.
+    ("pallas-mega", dict(mailbox_cap=2, batch=1, msg_words=1,
+                         max_sends=2, spill_cap=512, inject_slots=16,
+                         delivery="pallas_mega")),
 ]
 
 

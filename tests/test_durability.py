@@ -253,6 +253,57 @@ def test_v3_restore_keeps_telemetry(tmp_path):
     rt2.stop()
 
 
+def test_packed_snapshot_cross_dtype_restore(tmp_path):
+    """PR 11 bandwidth diet, snapshot spelling: save(packed=True)
+    stores the word tables as int16 lanes + an int32 escape plane; a
+    mid-flight world whose payloads do NOT fit int16 must restore
+    bit-identically to the plain-int32 snapshot of the same instant,
+    and a packed snapshot missing its escape plane must be a coded
+    corruption, never a silent zero-fill."""
+    from ponyc_tpu.models import ubench
+    okw = dict(mailbox_cap=4, batch=2, max_sends=1, spill_cap=64,
+               inject_slots=8)
+    rt, ids = ubench.build(8, _opts(**okw), pings=2)
+    # Payloads past the int16 edge: every in-flight hops counter rides
+    # the escape plane across the save/restore boundary.
+    ubench.seed_all(rt, ids, hops=70_000, pings=2)
+    rt.run(max_steps=6)
+    p_packed = str(tmp_path / "packed.npz")
+    p_plain = str(tmp_path / "plain.npz")
+    serialise.save(rt, p_packed, packed=True)
+    serialise.save(rt, p_plain)
+
+    with np.load(p_packed, allow_pickle=False) as z:
+        lo = [n for n in z.files if n.endswith(".lo16")]
+        assert lo, "packed snapshot stored no narrow planes"
+        assert all(z[n].dtype == np.int16 for n in lo)
+        esc = [n[:-len(".lo16")] + ".esc32" for n in lo]
+        assert all(n in z.files and z[n].dtype == np.int32 for n in esc)
+        # the escape plane genuinely carries the wide payloads
+        assert any(np.any(np.asarray(z[n]) != 0) for n in esc)
+
+    restored = {}
+    for path in (p_packed, p_plain):
+        rt2, _ = ubench.build(8, _opts(**okw), pings=2)
+        serialise.restore(rt2, path)
+        restored[path] = {
+            k: np.asarray(v) for k, v in
+            serialise._named_state_arrays(rt2.state).items()}
+    for k, v in restored[p_plain].items():
+        np.testing.assert_array_equal(restored[p_packed][k], v, err_msg=k)
+
+    # A torn packed snapshot (escape plane gone) is DETECTED:
+    header, arrays = serialise.capture(rt)
+    packed = serialise.pack_snapshot_arrays(arrays)
+    victim = next(n for n in packed if n.endswith(".esc32"))
+    del packed[victim]
+    p_torn = str(tmp_path / "torn.npz")
+    serialise.write_snapshot(header, packed, p_torn)
+    rt3, _ = ubench.build(8, _opts(**okw), pings=2)
+    with pytest.raises(serialise.SnapshotCorruptError):
+        serialise.restore(rt3, p_torn)
+
+
 # ============================================= geometry-changing restore
 
 def test_grown_capacity_restore_spawns_into_new_room(tmp_path):

@@ -135,6 +135,12 @@ CONFIGS = [
     ("fused-kernel", dict(mailbox_cap=4, batch=2, max_sends=3,
                           spill_cap=2048, inject_slots=16,
                           pallas_fused=True)),
+    # PR 11: persistent fused-window megakernel (ops/megakernel.py);
+    # the per-edge FIFO guarantee must survive the kernel boundary's
+    # int16+escape record packing bit-for-bit.
+    ("pallas-mega", dict(mailbox_cap=2, batch=1, max_sends=3,
+                         spill_cap=2048, inject_slots=16,
+                         delivery="pallas_mega")),
 ]
 
 

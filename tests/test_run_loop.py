@@ -495,3 +495,26 @@ print("SURVIVED-SIGTERM")
     assert "run_loop window=" in p.stderr, p.stderr
     assert "SURVIVED-SIGTERM" not in p.stdout
     assert "Traceback" not in p.stderr, p.stderr
+
+
+def test_window_constants_ride_optimization_barrier():
+    """Compile-time regression guard (PR 11 satellite, BENCH_r05): the
+    gated window's loop-invariant operands (injections, limit, force
+    bit) must sit behind lax.optimization_barrier in the lowered HLO.
+    Without it XLA constant-folds them INTO the while body and the
+    r05-style constant-propagation sweep re-runs per window compile —
+    the multi-minute stall BENCH_r05 recorded. The barrier's presence
+    in the StableHLO text is the cheapest stable proxy for "the hoist
+    survived lowering"."""
+    import jax
+    import jax.numpy as jnp
+    from ponyc_tpu.models import ubench
+    opts = RuntimeOptions(mailbox_cap=4, batch=1, max_sends=1,
+                          msg_words=1, spill_cap=64, inject_slots=8,
+                          **NO_CACHE)
+    rt, _ids = ubench.build(8, opts)
+    gated = engine.build_multi_step_gated(rt.program, rt.opts)
+    text = jax.jit(gated).lower(
+        rt.state, *rt._empty_inject, jnp.int32(4), jnp.bool_(True),
+        engine.zero_aux()).as_text()
+    assert "optimization_barrier" in text

@@ -97,6 +97,32 @@ def test_variants_auto_delivery_baseline_first():
     assert names == ["plan", "cosort"]
 
 
+def test_variants_auto_enumerates_megakernel_when_gated_on(monkeypatch):
+    """PR 11: with PONY_TPU_MEGA_AUTO=1 (bench.py sets it) delivery=auto
+    races the window megakernel too — as a pure-delivery variant, never
+    combined with the per-pass pallas kernels it replaces."""
+    monkeypatch.setenv("PONY_TPU_MEGA_AUTO", "1")
+    rt = Runtime(_ub_opts(delivery="auto"))
+    rt.declare(ubench.Pinger, 8)
+    rt.program.finalize()
+    vs = tuning.variants(rt.program, rt.opts)
+    assert [n for n, _ in vs] == ["plan", "cosort", "pallas_mega"]
+    mega = dict(vs)["pallas_mega"]
+    assert mega == {"delivery": "pallas_mega", "pallas": False,
+                    "pallas_fused": False}
+
+
+def test_tuning_key_version_pinned_v2():
+    """The cache-key version must be bumped whenever the variant space
+    changes (v2: pallas_mega joined) — a stale v1 record transferring a
+    two-way decision into the three-way race would silently skip the
+    megakernel forever. Pin it so the bump is a conscious act."""
+    rt = Runtime(_ub_opts(delivery="auto"))
+    rt.declare(ubench.Pinger, 8)
+    rt.program.finalize()
+    assert tuning.tuning_key(rt.program, rt.opts)["v"] == 2
+
+
 def test_variants_fused_auto_skips_ineligible_programs():
     # A blob-pool cohort is ineligible for the fused kernel; with every
     # cohort ineligible, pallas_fused="auto" must not enumerate (or
