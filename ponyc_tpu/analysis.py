@@ -128,11 +128,15 @@ class Analysis:
                           for b in rt.program.behaviour_table]
         self.dev_names = [c.atype.__name__
                           for c in rt.program.device_cohorts]
+        from .runtime.state import PHASE_NAMES, QW_BUCKETS
         self.columns = (CSV_COLUMNS
                         + [f"run:{n}" for n in self.beh_names]
                         + [c for n in self.dev_names
-                           for c in (f"qw50:{n}", f"qw99:{n}")])
-        from .runtime.state import QW_BUCKETS
+                           for c in (f"qw50:{n}", f"qw99:{n}")]
+                        # Per-phase window telemetry (ISSUE 19): one
+                        # work-unit delta column per scheduler phase
+                        # (engine.phase_cost_lanes).
+                        + [f"ph:{n}" for n in PHASE_NAMES])
         self._prev_hist = np.zeros((len(self.dev_names), QW_BUCKETS),
                                    np.int64)
         # Packed-record width for the bytes_msg column (see
@@ -149,9 +153,9 @@ class Analysis:
     def _telemetry(self):
         """One host read of the cumulative profiler matrix: returns
         (runs [NB] incl. host-dispatch counts, hist [ND, QW_BUCKETS],
-        ev_dropped total, gc-collected total)."""
+        ev_dropped total, gc-collected total, phases [N_PHASES])."""
         rt = self.rt
-        from .runtime.state import QW_BUCKETS
+        from .runtime.state import N_PHASES, QW_BUCKETS
         p = rt.program.shards
         nb = len(rt.program.behaviour_table)
         nd = len(rt.program.device_cohorts)
@@ -164,7 +168,10 @@ class Analysis:
             p, nd, QW_BUCKETS).sum(0)
         dropped = int(np.asarray(rt._fetch(st.ev_dropped)).sum())
         collected = int(np.asarray(rt._fetch(st.n_collected)).sum())
-        return runs, hist, dropped, collected
+        phases = np.asarray(
+            rt._fetch(st.phase_cost), np.int64).reshape(
+                p, N_PHASES).sum(0)
+        return runs, hist, dropped, collected, phases
 
     # -- window hook (called by Runtime.run after each window retire;
     # under the pipelined loop the writer runs while the next window is
@@ -179,7 +186,7 @@ class Analysis:
         # Counters ride the StepAux the run loop already fetched; the
         # profiler matrix is one extra small host read per window
         # boundary (never per tick).
-        runs, hist, dropped, collected = self._telemetry()
+        runs, hist, dropped, collected, phases = self._telemetry()
         if dropped and not self._warned_drops:
             # One-time loudness (satellite fix): a too-small event ring
             # used to lose level-3 trace events silently unless someone
@@ -224,6 +231,8 @@ class Analysis:
             self._prev_hist[di] = hist[di]
             row.append(hist_percentile(dh, 0.50))
             row.append(hist_percentile(dh, 0.99))
+        for i in range(phases.shape[0]):
+            row.append(self._delta(f"ph:{i}", int(phases[i])))
         self._rows.put(row)
 
     def _delta(self, key, cur) -> int:
@@ -415,6 +424,10 @@ class Analysis:
                          f"collected={g['collected']} "
                          f"blob_swept={g['blob_slots_reclaimed']} "
                          f"aborted={g['aborted']}")
+            ph = prof.get("phases")
+            if ph:
+                lines.append("phases " + " ".join(
+                    f"{n}={v}" for n, v in ph.items()))
             hot = sorted(prof["behaviours"].items(),
                          key=lambda kv: -kv[1]["runs"])
             for name, b in hot[:8]:
@@ -606,6 +619,7 @@ def chrome_trace(csv_path: str, out_path: str,
     run_cols = [c for c in header if c and c.startswith("run:")
                 and any(_int0(r.get(c)) for r in rows)]
     qw_cohorts = [c[5:] for c in header if c and c.startswith("qw50:")]
+    ph_cols = [c for c in header if c and c.startswith("ph:")]
     for row in rows:
         ts = float(row["time_ms"]) * 1e3          # µs
         for track, cols in (
@@ -631,6 +645,12 @@ def chrome_trace(csv_path: str, out_path: str,
                         "name": f"queue-wait {cn}",
                         "args": {"p50": _int0(row.get(f"qw50:{cn}")),
                                  "p99": _int0(row.get(f"qw99:{cn}"))}})
+        # Per-phase window telemetry (ISSUE 19): one counter track per
+        # scheduler phase — the per-window work-unit attribution lane.
+        for c in ph_cols:
+            out.append({"ph": "C", "pid": pid, "ts": ts,
+                        "name": f"phase {c[3:]}",
+                        "args": {"work": _int0(row.get(c))}})
     if events_path is None:
         cand = csv_path + ".events.csv"
         events_path = cand if os.path.exists(cand) else None
@@ -759,6 +779,11 @@ def top_frame(csv_path: str) -> str:
         lines.append("queue-wait (ticks): " + "  ".join(
             f"{n} p50={iv(last, 'qw50:' + n)} "
             f"p99={iv(last, 'qw99:' + n)}" for n in qw_names))
+    ph_cols = [c for c in (rows[0].keys() or [])
+               if c and c.startswith("ph:")]
+    if ph_cols:
+        lines.append("phases (work/win):  " + "  ".join(
+            f"{c[3:]}={iv(last, c)}" for c in ph_cols))
     # Causal traces (PROFILE.md §10): one row per recent trace from the
     # writer's .spans.jsonl stream, newest last.
     spans_path = csv_path + ".spans.jsonl"

@@ -237,6 +237,15 @@ class RuntimeOptions:
     #   Scrapes never touch the device: they render the snapshot the
     #   run loop last pushed at a window boundary (the same
     #   non-blocking posture as the analysis writer)
+    cost_capture: bool = False     # measured device-cost capture
+    #   (costs.py, ISSUE 19): at start(), AOT-compile the runtime's
+    #   real step/window executables and record their
+    #   cost_analysis()/memory_analysis() (bytes accessed, flops, peak
+    #   HBM) next to the modelled bytes/msg — one extra compile per
+    #   executable at start (the XLA disk cache absorbs the repeat).
+    #   HOST-side: the traced step never sees it, so the step jaxpr is
+    #   bit-identical with capture on or off. Off, the same capture is
+    #   available on demand via Runtime.measured_costs()
 
     # --- durable worlds (serialise.py Checkpointer + supervise.py;
     # ≙ nothing in the reference — Pony has no built-in checkpoint/

@@ -197,6 +197,12 @@ class FlightRecorder:
                         if getattr(rt, "_serve", None) is not None
                         else None),
             "watchdog": (None if wd is None else wd.snapshot()),
+            # Measured device costs (ISSUE 19): the costs.capture memo
+            # when the observatory ran — a host attribute, present so a
+            # crash dump states what the executables actually cost,
+            # not just what the model claimed. None pre-capture (and on
+            # every pre-PR-19 postmortem: readers must .get()).
+            "measured": getattr(rt, "_costs", None),
             "options": dataclasses.asdict(rt.opts)
             if getattr(rt, "opts", None) is not None else {},
             "env": env_snapshot(),
@@ -424,6 +430,32 @@ def render_postmortem(pm: Dict[str, Any]) -> str:
     if mail:
         lines.append("recent host mail: " + ", ".join(
             f"a{m['actor']}.{m['behaviour']}" for m in mail[-6:]))
+    # Measured device costs (ISSUE 19) — absent on pre-capture runs and
+    # every pre-PR-19 postmortem: .get() everything, render nothing
+    # rather than crash the crash report.
+    meas = pm.get("measured") or {}
+    for exe, rec in sorted((meas.get("executables") or {}).items()):
+        if not isinstance(rec, dict) or rec.get("error"):
+            continue
+        bits = []
+        if rec.get("flops") is not None:
+            bits.append(f"flops={rec['flops']:.3g}")
+        if rec.get("bytes_accessed") is not None:
+            bits.append(f"bytes={rec['bytes_accessed']:.3g}")
+        if rec.get("peak_bytes") is not None:
+            bits.append(f"peak={rec['peak_bytes']}B")
+        if bits:
+            lines.append(f"measured [{exe}] "
+                         f"({meas.get('backend', '?')}): "
+                         + " ".join(bits))
+    div = meas.get("model_divergence") or {}
+    if div.get("ratio") is not None:
+        verdict = ("DIVERGED" if div.get("diverged") else "ok")
+        lines.append(
+            f"model vs measured: {div.get('modelled_bytes')} vs "
+            f"{div.get('measured_bytes')} B/msg "
+            f"(ratio {div['ratio']}, tol {div.get('tolerance')}) "
+            f"-> {verdict}")
     tl = pm.get("probe_timeline")
     if tl:
         lines.append(f"backend probe attempts: {len(tl)}")

@@ -74,6 +74,7 @@ def snapshot(rt) -> Dict[str, Any]:
         snap["behaviours"] = prof["behaviours"]
         snap["cohorts"] = prof["cohorts"]
         snap["gc"] = dict(prof["gc"])
+        snap["phases"] = dict(prof.get("phases") or {})
     else:
         snap["totals"] = {
             "processed": int(rt.totals.get("processed", 0)),
@@ -103,6 +104,12 @@ def snapshot(rt) -> Dict[str, Any]:
     srv = getattr(rt, "_serve", None)
     if srv is not None:
         snap["serving"] = srv.stats()
+    # Measured device costs (ISSUE 19): captured once at start()
+    # (opts.cost_capture) or via Runtime.measured_costs() — a host
+    # attribute read here, never a compile.
+    costs = getattr(rt, "_costs", None)
+    if costs is not None:
+        snap["measured"] = costs
     snap["errors"] = [
         {"class": cls, "code": int(code), "count": int(n)}
         for (cls, code), n in sorted(rt._error_counts.items())]
@@ -234,6 +241,46 @@ def prometheus_text(snap: Dict[str, Any],
             "Muted actor-ticks per cohort",
             [({"cohort": c}, v["mute_ticks"])
              for c, v in sorted(coh.items())])
+    phases = snap.get("phases") or {}
+    if phases:
+        fam("pony_tpu_phase_work_total", "counter",
+            "Per-phase work units (delivery/drain/dispatch/gc_mark "
+            "tick-cost lanes, state.PHASE_NAMES)",
+            [({"phase": k}, v) for k, v in sorted(phases.items())])
+    measured = snap.get("measured") or {}
+    if measured:
+        rows_b, rows_f, rows_p = [], [], []
+        for exe, rec in sorted((measured.get("executables")
+                                or {}).items()):
+            if rec.get("bytes_accessed") is not None:
+                rows_b.append(({"executable": exe},
+                               rec["bytes_accessed"]))
+            if rec.get("flops") is not None:
+                rows_f.append(({"executable": exe}, rec["flops"]))
+            if rec.get("peak_bytes") is not None:
+                rows_p.append(({"executable": exe}, rec["peak_bytes"]))
+        if rows_b:
+            fam("pony_tpu_measured_bytes_accessed", "gauge",
+                "XLA cost_analysis bytes accessed per compiled "
+                "executable (costs.capture)", rows_b)
+        if rows_f:
+            fam("pony_tpu_measured_flops", "gauge",
+                "XLA cost_analysis flops per compiled executable",
+                rows_f)
+        if rows_p:
+            fam("pony_tpu_measured_peak_bytes", "gauge",
+                "Device working set per compiled executable "
+                "(memory_analysis: args+outputs+temps+code-aliased)",
+                rows_p)
+        div = measured.get("model_divergence") or {}
+        if div.get("ratio") is not None:
+            fam("pony_tpu_model_divergence_ratio", "gauge",
+                "Measured/modelled bytes-per-message ratio "
+                "(1.0 = the model holds)", [(None, div["ratio"])])
+            fam("pony_tpu_model_divergence", "gauge",
+                "1 when measured bytes/msg disagrees with the model "
+                "past tolerance", [(None, 1 if div.get("diverged")
+                                    else 0)])
     g = snap.get("gc", {})
     if g:
         fam("pony_tpu_gc_passes_total", "counter", "GC passes run",

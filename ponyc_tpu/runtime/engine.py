@@ -45,7 +45,7 @@ from ..ops import pack
 from ..ops.segment import compact_mask, counts_by_key, stable_sort_by
 from ..program import Cohort, Program
 from .delivery import (Entries, deliver, empty_mute_slots, mute_ref_slots)
-from .state import QW_BUCKETS, RtState, layout_sizes
+from .state import PHASE_NAMES, QW_BUCKETS, RtState, layout_sizes
 
 
 class StepAux(NamedTuple):
@@ -463,6 +463,44 @@ def profile_lanes(program: Program, opts: RuntimeOptions, st: RtState,
         beh_rej = beh_rej.at[g].add(_count(sp_ok & (sp_gid == g)))
 
     return beh_runs, beh_del, beh_rej, coh_mt, qw_hist, qw_enq
+
+
+def phase_cost_lanes(st: RtState, all_e, drain_facts, nproc_total,
+                     n_spawned, n_destroyed):
+    """Per-phase window telemetry (the device-cost observatory, ISSUE
+    19): accumulate one deterministic work-unit tally per scheduler-tick
+    phase into st.phase_cost (state.PHASE_NAMES order). ONLY traced when
+    opts.analysis >= 1 — the caller gates the call itself, so at level 0
+    none of this exists in the jaxpr (the zero-cost test traps this
+    function exactly like profile_lanes).
+
+    The tallies are recomputed from facts every dispatch formulation
+    already produces (the profile_lanes recomputation trick), so the XLA
+    scan window and the megakernel's jaxpr replay yield bit-identical
+    lanes by construction:
+
+      - delivery += valid delivery-list entries gathered this tick
+                    (spill retries + host injections + routed sends);
+      - drain    += mailbox ring slots consumed (head advances, the
+                    yield-shortened prefix included — >= dispatch:
+                    drained-but-dropped badmsg rows count here only);
+      - dispatch += behaviours actually run (the n_processed increment);
+      - gc_mark  += spawn/destroy bookkeeping rows touched (claimed
+                    spawns + completed destroys — the slot-lifecycle
+                    work the GC pass marks from).
+
+    Work units, not wall time: wall/bytes attribution is the measured
+    layer's job (costs.py)."""
+    pc = st.phase_cost
+    delivery = jnp.sum((all_e.tgt >= 0).astype(jnp.int32))
+    drained = jnp.int32(0)
+    for _ch, head0, head1 in drain_facts:
+        drained = drained + jnp.sum(head1 - head0)
+    pc = pc.at[PHASE_NAMES.index("delivery")].add(delivery)
+    pc = pc.at[PHASE_NAMES.index("drain")].add(drained)
+    pc = pc.at[PHASE_NAMES.index("dispatch")].add(nproc_total)
+    pc = pc.at[PHASE_NAMES.index("gc_mark")].add(n_spawned + n_destroyed)
+    return pc
 
 
 def trace_span_lanes(program: Program, opts: RuntimeOptions, st: RtState,
@@ -1865,12 +1903,16 @@ def build_step(program: Program, opts: RuntimeOptions):
             (beh_runs2, beh_del2, beh_rej2, coh_mt2, qw_hist2,
              qw_enq2) = profile_lanes(program, opts, st, tail0, res,
                                       drain_facts, muted2)
+            phase_cost2 = phase_cost_lanes(st, all_e, drain_facts,
+                                           nproc_total, n_spawned,
+                                           n_destroyed)
         else:
             beh_runs2, beh_del2, beh_rej2 = (st.beh_runs,
                                              st.beh_delivered,
                                              st.beh_rejected)
             coh_mt2, qw_hist2 = st.coh_mute_ticks, st.qwait_hist
             qw_enq2 = dict(st.qwait_enq)
+            phase_cost2 = st.phase_cost
 
         nrej_new = st.n_rejected[0] + res.n_rejected
         nbad_new = st.n_badmsg[0] + nbad_total
@@ -2012,6 +2054,7 @@ def build_step(program: Program, opts: RuntimeOptions):
             beh_runs=beh_runs2, beh_delivered=beh_del2,
             beh_rejected=beh_rej2, coh_mute_ticks=coh_mt2,
             qwait_hist=qw_hist2, qwait_enq=qw_enq2,
+            phase_cost=phase_cost2,
             trace_buf=res.trace_buf,
             span_data=span_data2 if tracing else st.span_data,
             span_count=(vec(span_count2) if tracing else st.span_count),

@@ -370,6 +370,7 @@ def build_gc(program: Program, opts: RuntimeOptions):
             beh_rejected=st.beh_rejected,
             coh_mute_ticks=st.coh_mute_ticks,
             qwait_hist=st.qwait_hist, qwait_enq=st.qwait_enq,
+            phase_cost=st.phase_cost,
             # Trace lanes/span ring pass through: collection dispatches
             # nothing, so no spans; dead rows' ring-slot lanes are
             # unreadable (head := tail) and re-stamped on next delivery.

@@ -259,6 +259,8 @@ class Runtime:
         #   metrics_port is not None
         self._ckpt = None             # serialise.Checkpointer when
         #   checkpoint_every_s is set (durable worlds, PROFILE.md §12)
+        self._costs = None            # costs.capture memo — measured
+        #   cost/memory analysis of the compiled executables (ISSUE 19)
         self._last_run_crashed = False  # run() exited exceptionally:
         #   stop() must NOT overwrite the ring's newest snapshot with
         #   the post-crash world (the supervisor restores the last
@@ -327,6 +329,16 @@ class Runtime:
             if stall is not None:
                 raise stall from None
             raise
+        if self.opts.cost_capture:
+            # Device-cost observatory (ISSUE 19): record XLA's own
+            # cost/memory analysis of the just-built executables so
+            # every BENCH json / postmortem / metrics scrape carries
+            # measured numbers next to the modelled ones. Opt-in: it
+            # AOT-compiles step+window once more (lower() only — the
+            # world does not advance).
+            from .. import costs as _costs
+            _costs.capture(self, force=True)
+            _costs.measured_block(self)
         if self.opts.metrics_port is not None:
             from .. import metrics as _metrics
             self._metrics = _metrics.MetricsServer(
@@ -1934,6 +1946,8 @@ class Runtime:
                                   "queue_wait_p50": int,   # ticks (2^k
                                   "queue_wait_p99": int,   #  bucket lo)
                                   "mute_ticks": int}},
+             "phases": {"delivery": int, "drain": int, "dispatch": int,
+                        "gc_mark": int},      # cumulative work units
              "totals": {"processed", "delivered", "rejected", "badmsg",
                         "deadletter", "mutes", "host_processed"},
              "gc": {"passes", "collected", "blob_slots_reclaimed",
@@ -1950,7 +1964,7 @@ class Runtime:
         if self.state is None:
             raise RuntimeError("call start() first")
         from ..analysis import hist_percentile
-        from .state import QW_BUCKETS
+        from .state import N_PHASES, PHASE_NAMES, QW_BUCKETS
         p = self.program.shards
         nb = len(self.program.behaviour_table)
         nd = len(self.program.device_cohorts)
@@ -1979,10 +1993,14 @@ class Runtime:
                 "queue_wait_p99": hist_percentile(h, 0.99),
                 "mute_ticks": int(mt[di]),
             }
+        ph = self._fetch(self.state.phase_cost).reshape(
+            p, N_PHASES).sum(0)
         return {
             "steps": self.steps_run,
             "behaviours": behaviours,
             "cohorts": cohorts,
+            "phases": {name: int(ph[i])
+                       for i, name in enumerate(PHASE_NAMES)},
             "totals": {
                 "processed": self.counter("n_processed"),
                 "delivered": self.counter("n_delivered"),
@@ -2001,6 +2019,54 @@ class Runtime:
                 "aborted": self.totals.get("gc_aborted", 0),
             },
         }
+
+    def measured_costs(self, force: bool = False) -> Dict[str, Any]:
+        """Measured, not modelled (costs.capture, ISSUE 19): XLA's own
+        ``cost_analysis()`` / ``memory_analysis()`` of this runtime's
+        REAL compiled step and pipelined-window executables — flops,
+        bytes accessed, argument/output/temp/peak bytes per executable.
+        Lazy and memoized (first call AOT-compiles each executable once
+        more; the world does not advance); ``opts.cost_capture=True``
+        runs it eagerly at start(). Works on CPU and TPU — fields a
+        backend doesn't report degrade to None."""
+        from .. import costs as _costs
+        return _costs.capture(self, force=force)
+
+    def profile_device(self, windows: int = 1, path: str | None = None,
+                       ticks: int | None = None) -> str:
+        """Wrap N real retired fused windows in a ``jax.profiler``
+        trace (xprof / tensorboard / perfetto-compatible, ISSUE 19) for
+        op-level device wall attribution — the measurement the modelled
+        bytes/msg numbers are judged against on silicon. Drives
+        ``windows`` forced fused windows of ``ticks`` ticks each (the
+        controller's current window by default) through the runtime's
+        own executable — the world genuinely advances and the retired
+        steps count in ``steps_run``. The first window runs OUTSIDE the
+        trace to absorb compilation. Returns the trace directory
+        (default ``<analysis_path or ponyc_xprof>.xprof``)."""
+        if self.state is None:
+            raise RuntimeError("call start() first")
+        import jax
+        from jax import profiler as _prof
+        if path is None:
+            base = self.opts.analysis_path or "ponyc_xprof"
+            path = base + ".xprof"
+        n = int(ticks if ticks is not None else self._controller.window)
+        limit = jnp.int32(max(1, n))
+        inj_t, inj_w = self._empty_inject
+        # Warm-up window outside the trace: compilation (or cache
+        # lookup) must not pollute the device timeline.
+        st, _aux, k = self._multi(self.state, inj_t, inj_w, limit)
+        self.state = st
+        self.steps_run += int(k)
+        with _prof.trace(path):
+            for _ in range(max(1, int(windows))):
+                st, _aux, k = self._multi(self.state, inj_t, inj_w,
+                                          limit)
+                jax.block_until_ready(st)
+                self.state = st
+                self.steps_run += int(k)
+        return path
 
     def traces(self) -> Dict[int, Dict[str, Any]]:
         """Reassembled causal traces (PROFILE.md §10): drains the

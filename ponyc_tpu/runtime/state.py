@@ -59,6 +59,17 @@ from ..program import Program
 # quantize() aggregations over the fork's USDT probes).
 QW_BUCKETS = 16
 
+# Per-phase window telemetry (the device-cost observatory, ISSUE 19):
+# one work-unit counter per scheduler-tick phase, accumulated on device
+# in engine.phase_cost_lanes. Work units are DETERMINISTIC per-phase
+# tallies (delivery-list entries gathered, ring slots drained,
+# behaviours dispatched, GC bookkeeping rows touched) — not wall time —
+# so the XLA scan window and the megakernel's jaxpr replay produce
+# bit-identical lanes by construction; wall/bytes attribution is the
+# measured layer's job (costs.py).
+PHASE_NAMES = ("delivery", "drain", "dispatch", "gc_mark")
+N_PHASES = len(PHASE_NAMES)
+
 # Span-ring record rows (causal tracing, PROFILE.md §10): the layout is
 # owned by tracing.py so the host reassembler and the device writer can
 # never drift. (trace_id, span_id, parent_span, behaviour_gid,
@@ -212,6 +223,13 @@ class RtState:
     qwait_enq: Dict[str, jnp.ndarray]  # {type: [cap, capacity]} int32 —
     #                               enqueue-step stamp per ring slot
     #                               (device cohorts; {} when analysis<1)
+    phase_cost: jnp.ndarray     # [P*N_PHASES] int32 — cumulative
+    #                               per-phase work units (PHASE_NAMES
+    #                               order: delivery gather entries,
+    #                               mailbox ring slots drained,
+    #                               behaviours dispatched, GC-mark
+    #                               bookkeeping rows). Zero-length when
+    #                               analysis < 1
 
     # Causal tracing (analysis >= 3 AND trace_sample > 0; PROFILE.md
     # §10; ≙ the fork's per-event rows following one message
@@ -385,6 +403,8 @@ def init_state(program: Program, opts: RuntimeOptions) -> RtState:
         qwait_enq=({ch.atype.__name__: jnp.zeros((c, ch.capacity), i32)
                     for ch in program.device_cohorts}
                    if opts.analysis >= 1 else {}),
+        phase_cost=jnp.zeros(
+            (p * (N_PHASES if opts.analysis >= 1 else 0),), i32),
         trace_buf=({ch.atype.__name__:
                     jnp.full((c, 2, ch.capacity), -1, i32)
                     for ch in program.cohorts}

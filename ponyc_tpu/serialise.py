@@ -223,8 +223,16 @@ def _unpack_snapshot_arrays(arrays: Dict[str, np.ndarray],
     return out
 
 
+# State fields added after a snapshot format was already in the wild:
+# a same-layout restore treats a missing named twin as zeros (cumulative
+# telemetry starts over) instead of rejecting the whole snapshot.
+_ZERO_IF_ABSENT = frozenset({"st.phase_cost"})
+
+
 def _take(arrays, name, like):
     arr = arrays.get(name)
+    if arr is None and name in _ZERO_IF_ABSENT:
+        return jnp.zeros(like.shape, like.dtype)
     if arr is None:
         raise FingerprintMismatch(f"snapshot is missing array {name!r}")
     if tuple(arr.shape) != tuple(like.shape):
@@ -619,7 +627,7 @@ def _restore_relayout(rt, header, Z: Dict[str, np.ndarray]) -> None:
     (tests/test_durability.py)."""
     from .ops import pack
     from .runtime import gc as gc_mod
-    from .runtime.state import QW_BUCKETS, init_state
+    from .runtime.state import N_PHASES, QW_BUCKETS, init_state
 
     prog, opts = rt.program, rt.opts
     old = _OldLayout(header["geometry"])
@@ -865,7 +873,8 @@ def _restore_relayout(rt, header, Z: Dict[str, np.ndarray]) -> None:
     nd = len(prog.device_cohorts)
     for name, cols in (("beh_runs", nb), ("beh_delivered", nb),
                        ("beh_rejected", nb), ("coh_mute_ticks", nd),
-                       ("qwait_hist", nd * QW_BUCKETS)):
+                       ("qwait_hist", nd * QW_BUCKETS),
+                       ("phase_cost", N_PHASES)):
         src = Z.get(f"st.{name}")
         if st[name].size and src is not None and src.size:
             dst = st[name].copy()

@@ -27,7 +27,10 @@ def bench_mod(tmp_path, monkeypatch):
 
 
 def test_bench_ubench_auto_smoke(bench_mod):
-    ub = bench_mod.bench_ubench(_args())
+    # --skip-measured: this test is about tuning, not the observatory
+    # (covered below) — skip the capture to keep the smoke fast.
+    ub = bench_mod.bench_ubench(_args(skip_measured=True))
+    assert ub["measured"] == {"skipped": True}
     # The fused window really advanced the world: every tick dispatched
     # actors×pings behaviours (the headline metric's denominator).
     assert ub["processed_counter_ok"]
@@ -49,7 +52,8 @@ def test_bench_ubench_auto_smoke(bench_mod):
 
 
 def test_bench_forced_delivery_skips_tuning(bench_mod):
-    ub = bench_mod.bench_ubench(_args(delivery="plan"))
+    ub = bench_mod.bench_ubench(_args(delivery="plan",
+                                      skip_measured=True))
     assert ub["processed_counter_ok"]
     assert ub["delivery"] == "plan"
     # No formulation was "auto" → no calibration record. (The default
@@ -79,6 +83,46 @@ def test_bench_telemetry_block(bench_mod):
         == t["actors"] * 2 * t["ticks"]
     assert t["queue_wait_ticks"]["Pinger"]["p50"] >= 1
     assert "gc_passes" in t and "mute_ticks" in t
+
+
+def test_bench_ubench_emits_measured_block(bench_mod):
+    """Every BENCH json carries a `measured` block (ISSUE 19): XLA's
+    cost/memory analysis of the run's real executables, the record
+    probe, and the model_divergence verdict against the modelled
+    bytes/msg."""
+    ub = bench_mod.bench_ubench(_args(xprof=0))
+    m = ub["measured"]
+    assert "error" not in m
+    assert m["executables"]["step"]["bytes_accessed"] > 0
+    assert m["executables"]["window"]["bytes_accessed"] > 0
+    assert m["modelled"] == ub["bytes_model"]
+    assert m["model_divergence"]["diverged"] is False
+
+
+def test_bench_perf_smoke_scoreboard_row(bench_mod, tmp_path, capsys,
+                                         monkeypatch):
+    """--perf-smoke (ISSUE 19): the observatory end-to-end — json with
+    the measured block on stdout, one flattened scoreboard row
+    appended to BENCH_HISTORY.jsonl, exit code 0."""
+    import json
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    monkeypatch.setattr(bench_mod, "HISTORY_PATH", str(hist))
+    rc = bench_mod.bench_perf_smoke(_args(xprof=0, platform="cpu"))
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert result["detail"]["perf_smoke"] is True
+    assert result["measured"]["model_divergence"]["diverged"] is False
+    assert result["history_path"] == str(hist)
+    rows = [json.loads(ln) for ln in hist.read_text().splitlines()]
+    assert len(rows) == 1
+    assert rows[0]["value"] == result["value"]
+    assert rows[0]["measured_step_bytes"] \
+        == result["measured"]["executables"]["step"]["bytes_accessed"]
+    # and the perf CLI ingests the row it just wrote
+    from ponyc_tpu import costs
+    loaded = costs.load_history(str(tmp_path))
+    assert len(loaded) == 1 and loaded[0]["value"] == result["value"]
+    assert costs.perf_check(loaded)["ok"]
 
 
 def test_bench_trace_smoke_block(bench_mod):
@@ -204,7 +248,8 @@ def test_bench_kernel_smoke_block(bench_mod, monkeypatch):
 def test_bench_ubench_records_packed_bytes(bench_mod):
     """Every run — not just --kernel-smoke ones — carries the packed
     record width so the standing telemetry can price msgs/s in bytes."""
-    ub = bench_mod.bench_ubench(_args(ticks=4, fuse=2))
+    ub = bench_mod.bench_ubench(_args(ticks=4, fuse=2,
+                                      skip_measured=True))
     bm = ub["bytes_model"]
     assert ub["packed_bytes_per_msg"] == bm["packed_bytes"] > 0
     assert bm["record_words"] == 2          # 1 target + msg_words=1

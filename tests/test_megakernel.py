@@ -103,11 +103,11 @@ def test_escape_rate_measures_state_tables():
 
 # ================================ the kernel vs the XLA window, bitwise
 
-def _window_states(delivery, hops, windows=3, ticks=4):
+def _window_states(delivery, hops, windows=3, ticks=4, **okw):
     """Advance a seeded 16-pinger world `windows` windows of `ticks`
     gated ticks through rt._multi and return its named state arrays
     plus the total ticks the windows reported."""
-    rt, ids = ubench.build(16, _opts(delivery=delivery), pings=2)
+    rt, ids = ubench.build(16, _opts(delivery=delivery, **okw), pings=2)
     ubench.seed_all(rt, ids, hops=hops, pings=2)
     st, inj = rt.state, rt._empty_inject
     ran = 0
@@ -130,6 +130,20 @@ def test_mega_window_bitwise_equals_xla_window():
     mega, ticks_m = _window_states("pallas_mega", hops=1000)
     assert ticks_p == ticks_m > 0
     _assert_bitwise_equal(plan, mega)
+
+
+def test_mega_window_phase_lanes_match_xla():
+    """Per-phase window telemetry (ISSUE 19): the tick-cost lanes
+    (delivery/drain/dispatch/gc_mark work units) are computed once in
+    local_step and ride the jaxpr replay into the megakernel, so the
+    two formulations must agree exactly — and actually count."""
+    plan, ticks_p = _window_states("plan", hops=1000, analysis=1)
+    mega, ticks_m = _window_states("pallas_mega", hops=1000, analysis=1)
+    assert ticks_p == ticks_m > 0
+    ph_p = np.asarray(plan["st.phase_cost"])
+    ph_m = np.asarray(mega["st.phase_cost"])
+    assert ph_p.size > 0 and int(ph_p.sum()) > 0
+    assert np.array_equal(ph_p, ph_m)
 
 
 def test_mega_window_escape_plane_payloads():

@@ -230,6 +230,33 @@ def test_level0_lanes_compile_to_baseline(monkeypatch):
     assert build(0) == baseline     # trap unreached, jaxpr bit-identical
     with pytest.raises(AssertionError, match="lanes traced"):
         build(1)                    # and it IS the only lane source
+    monkeypatch.undo()
+
+    # Same guarantee for the per-phase tick-cost lanes (ISSUE 19): the
+    # observatory must be jaxpr-bit-identical when off, and
+    # phase_cost_lanes must be the lanes' only source when on.
+    def boom2(*_a, **_k):
+        raise AssertionError("phase lanes traced at analysis=0")
+
+    monkeypatch.setattr(engine, "phase_cost_lanes", boom2)
+    assert build(0) == baseline
+    with pytest.raises(AssertionError, match="phase lanes traced"):
+        build(1)
+
+
+def test_phase_lanes_count_ring_work():
+    """Per-phase window telemetry (ISSUE 19): a 50-hop single-token
+    ring delivers/drains/dispatches exactly one work unit per hop and
+    marks nothing (no spawns or exits until the last hop's self.exit),
+    and the phases ride Runtime.profile()."""
+    rt, ids = ring.build(8, _opts(analysis=1))
+    rt.send(int(ids[0]), ring.RingNode.token, 50)
+    rt.run()
+    ph = rt.profile()["phases"]
+    assert ph["delivery"] == ph["drain"] == ph["dispatch"] == 50
+    # exit(0) requests world exit — no device spawn/destroy happened
+    assert ph["gc_mark"] == 0
+    rt.stop()
 
 
 # ------------------------------------------------------- GC window stats
